@@ -1,0 +1,19 @@
+"""pytorch_cifar_tpu — a TPU-native (JAX/XLA) CIFAR-10 training framework.
+
+Brand-new framework with the capability surface of the reference
+``aqualovers/pytorch-cifar`` (see SURVEY.md), redesigned TPU-first:
+
+- pure-functional models (flax.linen) in NHWC layout,
+- one jitted SPMD train step (``jax.value_and_grad`` + optax) instead of an
+  eager autograd loop (reference: main.py:99-113),
+- data parallelism via ``jax.sharding.Mesh`` + ``shard_map`` + ``psum``
+  instead of DataParallel/DDP+NCCL (reference: main_dist.py:140-144),
+- bf16 mixed precision policy instead of CUDA AMP + GradScaler
+  (reference: main_dist.py:179-191),
+- on-device batched augmentation under explicit PRNG keys instead of
+  DataLoader worker processes (reference: main.py:30-35,45).
+"""
+
+__version__ = "0.1.0"
+
+from pytorch_cifar_tpu.config import TrainConfig  # noqa: F401

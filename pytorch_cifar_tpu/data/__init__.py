@@ -1,0 +1,3 @@
+from pytorch_cifar_tpu.data.cifar10 import load_cifar10  # noqa: F401
+from pytorch_cifar_tpu.data.augment import augment_batch, normalize  # noqa: F401
+from pytorch_cifar_tpu.data.pipeline import Dataloader  # noqa: F401

@@ -1,0 +1,80 @@
+"""On-device batched augmentation under explicit PRNG keys.
+
+The reference augments per-sample on CPU inside DataLoader worker processes
+(RandomCrop(32, padding=4) + RandomHorizontalFlip + Normalize,
+main.py:30-35). TPU-first redesign: augmentation is a pure, batched jax
+function executed on device as the prologue of the jitted train step —
+vectorized over the batch, fused by XLA into the step, and requiring no host
+worker pool. Host->device traffic is raw uint8 (3 KB/image) instead of
+augmented fp32.
+
+All randomness flows through explicit ``jax.random`` keys (per-step,
+epoch-seeded), which also fixes the reference's missing
+``sampler.set_epoch`` determinism hazard (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)  # main.py:34
+CIFAR10_STD = (0.2023, 0.1994, 0.2010)
+
+
+def normalize(
+    x: jax.Array,
+    mean: Sequence[float] = CIFAR10_MEAN,
+    std: Sequence[float] = CIFAR10_STD,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """uint8 NHWC -> normalized float NHWC (ToTensor + Normalize)."""
+    mean = jnp.asarray(mean, jnp.float32) * 255.0
+    std = jnp.asarray(std, jnp.float32) * 255.0
+    x = (x.astype(jnp.float32) - mean) / std
+    return x.astype(dtype)
+
+
+def random_crop(key: jax.Array, x: jax.Array, padding: int = 4) -> jax.Array:
+    """Batched RandomCrop(32, padding=4): zero-pad then per-image offset.
+
+    Implemented as one padded tensor + vmapped dynamic_slice — static shapes
+    throughout, so XLA tiles it onto the VPU with no host round-trips.
+    """
+    n, h, w, c = x.shape
+    pad = [(0, 0), (padding, padding), (padding, padding), (0, 0)]
+    xp = jnp.pad(x, pad)
+    offs = jax.random.randint(key, (n, 2), 0, 2 * padding + 1)
+
+    def crop_one(img, off):
+        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+
+    return jax.vmap(crop_one)(xp, offs)
+
+
+def random_hflip(key: jax.Array, x: jax.Array) -> jax.Array:
+    """Batched RandomHorizontalFlip(p=0.5) via a per-image select."""
+    n = x.shape[0]
+    flip = jax.random.bernoulli(key, 0.5, (n, 1, 1, 1))
+    return jnp.where(flip, x[:, :, ::-1, :], x)
+
+
+def augment_batch(
+    key: jax.Array,
+    x: jax.Array,
+    crop: bool = True,
+    flip: bool = True,
+    mean: Sequence[float] = CIFAR10_MEAN,
+    std: Sequence[float] = CIFAR10_STD,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Full train-time pipeline: crop -> flip -> normalize (uint8 in)."""
+    kc, kf = jax.random.split(key)
+    if crop:
+        x = random_crop(kc, x)
+    if flip:
+        x = random_hflip(kf, x)
+    return normalize(x, mean, std, dtype)

@@ -1,0 +1,116 @@
+"""CIFAR-10 dataset loading without the torchvision dependency.
+
+The reference uses torchvision's ``CIFAR10(download=True)`` (main.py:42-48),
+which fetches the python-pickle archive and unpacks
+``data_batch_1..5`` + ``test_batch``. We parse the same on-disk layout
+directly with numpy, search a few conventional locations, optionally
+download, and fall back to a deterministic synthetic set so the framework
+runs in zero-egress environments (tests, benchmarks).
+
+Arrays are returned in NHWC uint8 (TPU-preferred layout) + int32 labels.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Tuple
+
+import numpy as np
+
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+_DIRNAME = "cifar-10-batches-py"
+
+Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+
+
+def _parse_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    # stored as (N, 3072) uint8, channel-major rows -> NHWC
+    x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    y = np.asarray(d[b"labels"], dtype=np.int32)
+    return np.ascontiguousarray(x), y
+
+
+def _load_from_dir(batches_dir: str) -> Arrays:
+    xs, ys = [], []
+    for i in range(1, 6):
+        x, y = _parse_batch(os.path.join(batches_dir, f"data_batch_{i}"))
+        xs.append(x)
+        ys.append(y)
+    train_x = np.concatenate(xs)
+    train_y = np.concatenate(ys)
+    test_x, test_y = _parse_batch(os.path.join(batches_dir, "test_batch"))
+    return train_x, train_y, test_x, test_y
+
+
+def _find_dataset(data_dir: str):
+    candidates = [
+        os.path.join(data_dir, _DIRNAME),
+        os.path.join(data_dir, "cifar10", _DIRNAME),
+        os.path.expanduser("~/data/" + _DIRNAME),
+        "/root/data/" + _DIRNAME,
+    ]
+    env = os.environ.get("CIFAR10_PATH")
+    if env:
+        candidates.insert(0, env)
+    for c in candidates:
+        if os.path.isfile(os.path.join(c, "data_batch_1")):
+            return c
+    return None
+
+
+def _try_download(data_dir: str):
+    """Best-effort download (the reference's download=True, main.py:42)."""
+    import urllib.request
+
+    os.makedirs(data_dir, exist_ok=True)
+    archive = os.path.join(data_dir, "cifar-10-python.tar.gz")
+    try:
+        if not os.path.exists(archive):
+            urllib.request.urlretrieve(CIFAR10_URL, archive)
+        with tarfile.open(archive, "r:gz") as tf:
+            tf.extractall(data_dir)
+        return os.path.join(data_dir, _DIRNAME)
+    except Exception:
+        return None
+
+
+def synthetic_cifar10(
+    n_train: int = 2048, n_test: int = 512, seed: int = 0
+) -> Arrays:
+    """Deterministic class-separable stand-in with the real shapes/dtypes.
+
+    Each class gets a fixed random 32x32x3 template; samples are the template
+    plus noise, so short training runs show a decreasing loss — enough signal
+    for integration tests and throughput benchmarks.
+    """
+    rng = np.random.RandomState(seed)
+    templates = rng.randint(0, 256, size=(10, 32, 32, 3)).astype(np.float32)
+
+    def make(n, seed_off):
+        r = np.random.RandomState(seed + seed_off)
+        y = r.randint(0, 10, size=n).astype(np.int32)
+        noise = r.normal(0.0, 48.0, size=(n, 32, 32, 3))
+        x = np.clip(templates[y] + noise, 0, 255).astype(np.uint8)
+        return x, y
+
+    train_x, train_y = make(n_train, 1)
+    test_x, test_y = make(n_test, 2)
+    return train_x, train_y, test_x, test_y
+
+
+def load_cifar10(data_dir: str = "./data", synthetic_ok: bool = True) -> Arrays:
+    found = _find_dataset(data_dir)
+    if found is None:
+        found = _try_download(data_dir)
+    if found is not None:
+        return _load_from_dir(found)
+    if synthetic_ok:
+        return synthetic_cifar10()
+    raise FileNotFoundError(
+        f"CIFAR-10 not found under {data_dir!r} and download failed; "
+        "set CIFAR10_PATH or pass synthetic_ok=True"
+    )

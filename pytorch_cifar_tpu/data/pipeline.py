@@ -1,0 +1,119 @@
+"""Host-side input pipeline: epoch-seeded shuffle + sharded device prefetch.
+
+Replaces the reference's DataLoader worker pool + DistributedSampler
+(main.py:44-50, main_dist.py:109-127). Work split:
+
+- host (this module): shuffle an index permutation per epoch, gather uint8
+  slices, ``jax.device_put`` onto the batch-sharded mesh axis with one batch
+  of lookahead (double buffering);
+- device (augment.py): crop/flip/normalize inside the jitted step.
+
+Sharding semantics match the reference's ``global batch / world_size``
+(main_dist.py:111-115): the global batch is laid out over the mesh's data
+axis by NamedSharding, so each device reads batch/n_devices images. The
+per-epoch reshuffle is seeded with (seed, epoch) — the determinism the
+reference loses by never calling ``sampler.set_epoch`` (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class Dataloader:
+    """Iterates (images_uint8, labels_int32) device batches for one epoch."""
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+        sharding: Optional[jax.sharding.Sharding] = None,
+        prefetch: int = 2,
+    ):
+        assert images.shape[0] == labels.shape[0]
+        self.images = images
+        self.labels = labels
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        # Like the reference's drop_last=False default, a ragged final batch
+        # would retrigger XLA compilation per distinct shape; on TPU we drop
+        # it for train and pad for eval (see eval_batches).
+        self.drop_last = drop_last
+        self.seed = seed
+        self.sharding = sharding
+        self.prefetch = max(1, prefetch)
+
+    def __len__(self) -> int:
+        n = self.images.shape[0]
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def epoch(self, epoch: int) -> Iterator[Tuple[jax.Array, jax.Array]]:
+        n = self.images.shape[0]
+        if self.shuffle:
+            order = np.random.RandomState(
+                (self.seed * 100003 + epoch) % (2**31)
+            ).permutation(n)
+        else:
+            order = np.arange(n)
+        nb = len(self)
+
+        def host_batches():
+            for b in range(nb):
+                idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+                x = self.images[idx]
+                y = self.labels[idx]
+                if not self.drop_last and x.shape[0] < self.batch_size:
+                    pad = self.batch_size - x.shape[0]
+                    x = np.concatenate([x, np.zeros_like(x[:1]).repeat(pad, 0)])
+                    y = np.concatenate([y, np.full((pad,), -1, y.dtype)])
+                yield x, y
+
+        # double-buffer: keep `prefetch` batches in flight on device
+        queue = collections.deque()
+        it = host_batches()
+        try:
+            while True:
+                while len(queue) < self.prefetch:
+                    x, y = next(it)
+                    queue.append(self._put(x, y))
+                yield queue.popleft()
+        except StopIteration:
+            while queue:
+                yield queue.popleft()
+
+    def _put(self, x: np.ndarray, y: np.ndarray):
+        if self.sharding is not None:
+            x = jax.device_put(x, self.sharding)
+            y = jax.device_put(y, self.sharding)
+        else:
+            x = jax.device_put(x)
+            y = jax.device_put(y)
+        return x, y
+
+
+def eval_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):
+    """Padded, unshuffled eval batches; labels padded with -1 (masked out).
+
+    The reference evals the full unsharded test set on every rank with no
+    metric reduction (main_dist.py:205-252, SURVEY.md §2.5.7); here eval is
+    sharded like train and metrics are psum-reduced, with -1 padding labels
+    excluded from both loss and accuracy denominators.
+    """
+    n = images.shape[0]
+    nb = -(-n // batch_size)
+    for b in range(nb):
+        x = images[b * batch_size : (b + 1) * batch_size]
+        y = labels[b * batch_size : (b + 1) * batch_size]
+        if x.shape[0] < batch_size:
+            pad = batch_size - x.shape[0]
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+            y = np.concatenate([y, np.full((pad,), -1, y.dtype)])
+        yield x, y

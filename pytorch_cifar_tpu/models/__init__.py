@@ -1,0 +1,39 @@
+"""Model zoo registry.
+
+The reference selects models by editing source (main.py:57-71 hardcodes
+SimpleDLA; main_dist.py:136 hardcodes ResNet152 — SURVEY.md §2.5.11). Here
+every architecture is a named factory in ``MODEL_REGISTRY`` and selectable
+via ``--model``. Factories take ``(num_classes=10, dtype=None)`` and return
+a flax Module with signature ``module(x_nhwc, train: bool)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.lenet import LeNet
+
+MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
+
+
+def register(name: str, factory: Callable[..., nn.Module]) -> None:
+    MODEL_REGISTRY[name] = factory
+
+
+def create_model(
+    name: str, num_classes: int = 10, dtype: Optional[Any] = None, **kwargs
+) -> nn.Module:
+    if name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
+        )
+    return MODEL_REGISTRY[name](num_classes=num_classes, dtype=dtype, **kwargs)
+
+
+def available_models():
+    return sorted(MODEL_REGISTRY)
+
+
+register("LeNet", LeNet)

@@ -1,0 +1,183 @@
+"""Shared building blocks for the model zoo.
+
+All models are flax.linen Modules in **NHWC** layout (XLA:TPU's preferred
+layout; the reference is NCHW but layout is free to change — SURVEY.md §7.6).
+Every model maps ``(N, 32, 32, 3) float -> (N, 10)`` logits, the NHWC
+equivalent of the reference contract (SURVEY.md §1 L2).
+
+Initializers reproduce PyTorch *defaults* (the reference relies on them —
+its own ``init_params`` helper is dead code, utils.py:30-43 / SURVEY.md
+§2.5.3), so accuracy curves are comparable:
+
+- Conv2d default: kaiming_uniform(a=sqrt(5)) == U(-b, b), b = 1/sqrt(fan_in),
+  fan_in = kh*kw*in_ch/groups; bias U(-b, b) with the same fan_in.
+- Linear default: U(-b, b), b = 1/sqrt(in_features) for weight and bias.
+- BatchNorm: scale=1, bias=0, running stats (0, 1).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+# ---------------------------------------------------------------------------
+# PyTorch-default initializers
+# ---------------------------------------------------------------------------
+
+
+def torch_conv_kernel_init(key, shape, dtype=jnp.float32):
+    """U(-1/sqrt(fan_in), 1/sqrt(fan_in)); flax kernel shape (kh, kw, cin/g, cout)."""
+    fan_in = shape[0] * shape[1] * shape[2]
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+def torch_conv_bias_init(fan_in: int):
+    bound = 1.0 / math.sqrt(fan_in)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+def torch_linear_kernel_init(key, shape, dtype=jnp.float32):
+    """U(-1/sqrt(in_features), ...); flax dense kernel shape (in, out)."""
+    bound = 1.0 / math.sqrt(shape[0])
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+def torch_linear_bias_init(in_features: int):
+    bound = 1.0 / math.sqrt(in_features)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+# ---------------------------------------------------------------------------
+# Layers
+# ---------------------------------------------------------------------------
+
+
+class Conv(nn.Module):
+    """2D conv with PyTorch-default init and PyTorch-style int padding.
+
+    ``padding=p`` means p pixels of zero padding on every side (torch
+    semantics), not SAME/VALID.
+    """
+
+    features: int
+    kernel_size: Union[int, Tuple[int, int]]
+    strides: int = 1
+    padding: int = 0
+    groups: int = 1
+    use_bias: bool = True
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        ks = (
+            (self.kernel_size, self.kernel_size)
+            if isinstance(self.kernel_size, int)
+            else tuple(self.kernel_size)
+        )
+        in_ch = x.shape[-1]
+        fan_in = ks[0] * ks[1] * (in_ch // self.groups)
+        return nn.Conv(
+            features=self.features,
+            kernel_size=ks,
+            strides=(self.strides, self.strides),
+            padding=[(self.padding, self.padding)] * 2,
+            feature_group_count=self.groups,
+            use_bias=self.use_bias,
+            kernel_init=torch_conv_kernel_init,
+            bias_init=torch_conv_bias_init(fan_in),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+
+
+class Dense(nn.Module):
+    """Linear layer with PyTorch-default init."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            features=self.features,
+            use_bias=self.use_bias,
+            kernel_init=torch_linear_kernel_init,
+            bias_init=torch_linear_bias_init(x.shape[-1]),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+
+
+class BatchNorm(nn.Module):
+    """BatchNorm matching torch BatchNorm2d defaults.
+
+    torch: eps=1e-5, momentum=0.1 (new = 0.9*old + 0.1*batch), affine, biased
+    batch variance for normalization. flax BatchNorm momentum is the *keep*
+    factor, so torch momentum 0.1 == flax momentum 0.9.
+
+    Stats live in the ``batch_stats`` collection (the functional equivalent of
+    torch running buffers); they are parameters of neither count nor training.
+    Stats and normalization run in fp32 regardless of compute dtype.
+    """
+
+    use_running_average: Optional[bool] = None
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        ura = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        return nn.BatchNorm(
+            use_running_average=ura,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(x)
+
+
+def max_pool(x, window: int, stride: Optional[int] = None, padding: int = 0):
+    stride = stride or window
+    return nn.max_pool(
+        x,
+        window_shape=(window, window),
+        strides=(stride, stride),
+        padding=[(padding, padding)] * 2,
+    )
+
+
+def avg_pool(x, window: int, stride: Optional[int] = None, padding: int = 0):
+    stride = stride or window
+    return nn.avg_pool(
+        x,
+        window_shape=(window, window),
+        strides=(stride, stride),
+        padding=[(padding, padding)] * 2,
+    )
+
+
+def global_avg_pool(x):
+    """adaptive_avg_pool2d(1) + flatten, NHWC."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

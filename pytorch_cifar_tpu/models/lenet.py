@@ -1,0 +1,33 @@
+"""LeNet-5 for CIFAR-10 (reference: models/lenet.py:5-23).
+
+The only zoo model with no BatchNorm: 2 valid-padding 5x5 convs with bias,
+each followed by ReLU + 2x2 maxpool, then three fully-connected layers
+(400-120-84-10). 62,006 params (BASELINE.md golden).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import Conv, Dense, max_pool
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(Conv(6, 5, dtype=self.dtype)(x))
+        x = max_pool(x, 2)
+        x = nn.relu(Conv(16, 5, dtype=self.dtype)(x))
+        x = max_pool(x, 2)
+        # NHWC flatten ordering differs from torch's NCHW, but the fc1 weight
+        # is learned from scratch either way — only the 400-dim size matters.
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(Dense(84, dtype=self.dtype)(x))
+        return Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,3 @@
+from pytorch_cifar_tpu.train.state import TrainState, create_train_state  # noqa: F401
+from pytorch_cifar_tpu.train.optim import make_optimizer, cosine_epoch_schedule  # noqa: F401
+from pytorch_cifar_tpu.train.steps import make_train_step, make_eval_step  # noqa: F401

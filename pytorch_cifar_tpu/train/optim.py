@@ -1,0 +1,47 @@
+"""Optimizer: SGD + momentum + coupled weight decay + per-epoch cosine LR.
+
+Reproduces the reference recipe (main.py:86-89) with torch-exact semantics:
+
+- torch SGD weight_decay is *coupled* L2 added to the gradient **before** the
+  momentum buffer update (buf = m*buf + (g + wd*p); p -= lr*buf). The optax
+  chain add_decayed_weights -> trace -> scale_by_lr matches that ordering.
+  Decay applies to every parameter, including BN scale/bias — the reference
+  does not mask anything.
+- torch CosineAnnealingLR steps **per epoch**: lr(e) = lr0*(1+cos(pi*e/T))/2.
+  We express it as a per-update schedule via floor(step / steps_per_epoch)
+  so lr is constant within an epoch, exactly like scheduler.step() placement
+  at main.py:154.
+- ``t_max`` is independent of ``epochs`` so the reference's T_max=200 vs
+  epochs=100 mismatch (main_dist.py:162 vs :28, SURVEY.md §2.5.4) can be
+  replicated deliberately via config.cosine_t_max.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def cosine_epoch_schedule(
+    lr: float, t_max: int, steps_per_epoch: int
+) -> optax.Schedule:
+    def schedule(step):
+        epoch = jnp.floor_divide(step, steps_per_epoch)
+        return 0.5 * lr * (1.0 + jnp.cos(jnp.pi * epoch / t_max))
+
+    return schedule
+
+
+def make_optimizer(
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    weight_decay: float = 5e-4,
+    t_max: int = 200,
+    steps_per_epoch: int = 391,
+) -> optax.GradientTransformation:
+    schedule = cosine_epoch_schedule(lr, t_max, steps_per_epoch)
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.trace(decay=momentum, nesterov=False),
+        optax.scale_by_learning_rate(schedule),  # negates, like torch p -= lr*buf
+    )

@@ -1,0 +1,131 @@
+"""Jitted train/eval steps.
+
+The reference's hot loop (main.py:99-113) is eager: per-batch H2D copy,
+autograd backward, optimizer step, and a blocking ``loss.item()`` sync every
+iteration. Here the whole iteration — on-device augmentation, forward, loss,
+backward, SGD update, metric accumulation — is ONE traced function compiled
+once by XLA, with donated state buffers and no host sync in the loop.
+
+``axis_name`` plumbs the data-parallel mesh axis: when set (shard_map path,
+parallel/dp.py), gradients and metrics are psum'd across devices — the
+TPU-native replacement for DDP's bucketed NCCL all-reduce
+(main_dist.py:140-144). BatchNorm normalizes over the *local* per-device
+batch (parity with the reference's non-Sync BN under DDP, SURVEY.md §7.2)
+while updated running stats are pmean'd so eval statistics are deterministic
+across hosts (an intentional improvement over per-rank stats drift).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pytorch_cifar_tpu.data.augment import CIFAR10_MEAN, CIFAR10_STD, augment_batch, normalize
+from pytorch_cifar_tpu.train.state import TrainState
+
+Metrics = dict
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over valid (label >= 0) entries, computed in fp32."""
+    logits = logits.astype(jnp.float32)
+    valid = labels >= 0
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.maximum(labels, 0)
+    )
+    losses = jnp.where(valid, losses, 0.0)
+    return losses.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def _metrics(logits, labels) -> Metrics:
+    valid = labels >= 0
+    pred = jnp.argmax(logits, axis=-1)
+    correct = jnp.sum((pred == labels) & valid)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), jnp.maximum(labels, 0)
+    )
+    return {
+        "loss_sum": jnp.where(valid, losses, 0.0).sum(),
+        "correct": correct.astype(jnp.float32),
+        "count": valid.sum().astype(jnp.float32),
+    }
+
+
+def make_train_step(
+    augment: bool = True,
+    crop: bool = True,
+    flip: bool = True,
+    mean: Sequence[float] = CIFAR10_MEAN,
+    std: Sequence[float] = CIFAR10_STD,
+    compute_dtype=jnp.float32,
+    axis_name: Optional[str] = None,
+) -> Callable:
+    """Returns step(state, batch=(uint8 images, labels), rng) -> (state, metrics)."""
+
+    def step(state: TrainState, batch, rng) -> Tuple[TrainState, Metrics]:
+        images, labels = batch
+        key = jax.random.fold_in(rng, state.step)
+        if axis_name is not None:
+            # decorrelate augmentation across data-parallel shards
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        if augment:
+            x = augment_batch(
+                key, images, crop=crop, flip=flip, mean=mean, std=std,
+                dtype=compute_dtype,
+            )
+        else:
+            x = normalize(images, mean, std, dtype=compute_dtype)
+
+        def loss_fn(params):
+            variables = {"params": params, "batch_stats": state.batch_stats}
+            out = state.apply_fn(
+                variables, x, train=True, mutable=["batch_stats"],
+                rngs={"stochastic": key},
+            )
+            logits, mutated = out
+            loss = cross_entropy(logits, labels)
+            return loss, (logits, mutated.get("batch_stats", state.batch_stats))
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+
+        metrics = _metrics(logits, labels)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+            new_stats = jax.lax.pmean(new_stats, axis_name)
+            metrics = jax.tree_util.tree_map(
+                lambda m: jax.lax.psum(m, axis_name), metrics
+            )
+        state = state.apply_gradients(grads)
+        state = state.replace(batch_stats=new_stats)
+        return state, metrics
+
+    return step
+
+
+def make_eval_step(
+    mean: Sequence[float] = CIFAR10_MEAN,
+    std: Sequence[float] = CIFAR10_STD,
+    compute_dtype=jnp.float32,
+    axis_name: Optional[str] = None,
+) -> Callable:
+    """Returns step(state, batch) -> metrics. Labels < 0 are padding."""
+
+    def step(state: TrainState, batch) -> Metrics:
+        images, labels = batch
+        x = normalize(images, mean, std, dtype=compute_dtype)
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        logits = state.apply_fn(variables, x, train=False)
+        metrics = _metrics(logits, labels)
+        if axis_name is not None:
+            metrics = jax.tree_util.tree_map(
+                lambda m: jax.lax.psum(m, axis_name), metrics
+            )
+        return metrics
+
+    return step

@@ -1,0 +1,40 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the distributed-testing strategy the reference could not have
+(SURVEY.md §4): all mesh/shard_map/psum paths run in CI on a simulated
+8-device host, no TPU required.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A site-installed TPU plugin may override jax_platforms in jax.config at
+# interpreter startup (ignoring the env var), which would make every test
+# process pay a multi-minute remote-TPU handshake. Force CPU at the config
+# level before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cifar_synthetic():
+    from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
+
+    return synthetic_cifar10(n_train=512, n_test=256)
+
+
+@pytest.fixture(scope="session")
+def rng():
+    import jax
+
+    return jax.random.PRNGKey(0)

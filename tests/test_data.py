@@ -1,0 +1,81 @@
+"""Data pipeline tests: synthetic loader, augmentation, sharded batches."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_cifar_tpu.data.augment import augment_batch, normalize, random_crop, random_hflip
+from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
+from pytorch_cifar_tpu.data.pipeline import Dataloader, eval_batches
+
+
+def test_synthetic_shapes():
+    tx, ty, vx, vy = synthetic_cifar10(n_train=512, n_test=128)
+    assert tx.shape == (512, 32, 32, 3) and tx.dtype == np.uint8
+    assert ty.shape == (512,) and ty.dtype == np.int32
+    assert vx.shape == (128, 32, 32, 3)
+    assert set(np.unique(ty)) <= set(range(10))
+
+
+def test_synthetic_deterministic():
+    a = synthetic_cifar10(n_train=64, n_test=16)
+    b = synthetic_cifar10(n_train=64, n_test=16)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_normalize_stats():
+    x = jnp.full((2, 32, 32, 3), 255, jnp.uint8)
+    out = normalize(x)
+    expect = (1.0 - np.array([0.4914, 0.4822, 0.4465])) / np.array(
+        [0.2023, 0.1994, 0.2010]
+    )
+    np.testing.assert_allclose(np.asarray(out[0, 0, 0]), expect, rtol=1e-4)
+
+
+def test_random_crop_preserves_shape_and_content_domain():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.randint(key, (8, 32, 32, 3), 0, 256, jnp.int32).astype(jnp.uint8)
+    out = random_crop(key, x)
+    assert out.shape == x.shape and out.dtype == x.dtype
+    # different key -> different crops (with overwhelming probability)
+    out2 = random_crop(jax.random.PRNGKey(1), x)
+    assert not np.array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_random_hflip_is_flip_or_identity():
+    key = jax.random.PRNGKey(0)
+    x = np.arange(4 * 32 * 32 * 3, dtype=np.uint8).reshape(4, 32, 32, 3)
+    out = np.asarray(random_hflip(key, jnp.asarray(x)))
+    for i in range(4):
+        ok = np.array_equal(out[i], x[i]) or np.array_equal(out[i], x[i, :, ::-1])
+        assert ok
+
+
+def test_augment_batch_dtype():
+    key = jax.random.PRNGKey(0)
+    x = jnp.zeros((4, 32, 32, 3), jnp.uint8)
+    out = augment_batch(key, x, dtype=jnp.bfloat16)
+    assert out.dtype == jnp.bfloat16 and out.shape == (4, 32, 32, 3)
+
+
+def test_dataloader_epoch_reshuffle_deterministic():
+    x = np.arange(64, dtype=np.uint8).reshape(64, 1, 1, 1).repeat(32, 1).repeat(32, 2).repeat(3, 3)
+    y = np.arange(64, dtype=np.int32)
+    dl = Dataloader(x, y, batch_size=16, seed=3)
+    e0a = [np.asarray(b[1]) for b in dl.epoch(0)]
+    e0b = [np.asarray(b[1]) for b in dl.epoch(0)]
+    e1 = [np.asarray(b[1]) for b in dl.epoch(1)]
+    np.testing.assert_array_equal(np.concatenate(e0a), np.concatenate(e0b))
+    assert not np.array_equal(np.concatenate(e0a), np.concatenate(e1))
+    assert len(e0a) == 4
+
+
+def test_eval_batches_padding():
+    x = np.zeros((10, 32, 32, 3), np.uint8)
+    y = np.arange(10, dtype=np.int32)
+    bs = list(eval_batches(x, y, 4))
+    assert len(bs) == 3
+    assert bs[2][0].shape[0] == 4
+    assert list(bs[2][1]) == [8, 9, -1, -1]

@@ -1,0 +1,51 @@
+"""Model zoo tests: golden param counts (BASELINE.md, measured from the
+reference under torch 2.13) + forward shape + gradient smoke.
+
+The golden table is THE cross-framework invariant (SURVEY.md §6): equal
+param counts mean the architectures match layer-for-layer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_cifar_tpu.models import available_models, create_model
+from pytorch_cifar_tpu.models.common import count_params
+
+# name -> golden param count (BASELINE.md / SURVEY.md §2.2)
+GOLDEN_PARAMS = {
+    "LeNet": 62_006,
+}
+
+
+def init_model(name, batch=2):
+    model = create_model(name)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((batch, 32, 32, 3)), train=False
+    )
+    return model, variables
+
+
+@pytest.mark.parametrize("name", sorted(GOLDEN_PARAMS))
+def test_param_count_golden(name):
+    _, variables = init_model(name)
+    assert count_params(variables["params"]) == GOLDEN_PARAMS[name]
+
+
+@pytest.mark.parametrize("name", sorted(GOLDEN_PARAMS))
+def test_forward_shape(name):
+    model, variables = init_model(name, batch=3)
+    out = model.apply(variables, jnp.zeros((3, 32, 32, 3)), train=False)
+    assert out.shape == (3, 10)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_registry_contains_all_models():
+    assert set(GOLDEN_PARAMS) <= set(available_models())
+
+
+def test_unknown_model_raises():
+    with pytest.raises(KeyError):
+        create_model("NotAModel")
