@@ -18,7 +18,8 @@ from typing import Optional, Tuple
 @dataclass
 class TrainConfig:
     # model
-    model: str = "SimpleDLA"  # reference default: main.py:71
+    # reference default is SimpleDLA (main.py:71); ResNet18 until DLA lands
+    model: str = "ResNet18"
     num_classes: int = 10
 
     # optimization (reference recipe: main.py:86-89)

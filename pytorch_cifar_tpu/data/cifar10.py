@@ -72,9 +72,19 @@ def _try_download(data_dir: str):
         if not os.path.exists(archive):
             urllib.request.urlretrieve(CIFAR10_URL, archive)
         with tarfile.open(archive, "r:gz") as tf:
-            tf.extractall(data_dir)
+            if hasattr(tarfile, "data_filter"):
+                tf.extractall(data_dir, filter="data")
+            else:  # pragma: no cover - pre-3.12
+                tf.extractall(data_dir)
         return os.path.join(data_dir, _DIRNAME)
     except Exception:
+        # A truncated archive from an interrupted download would otherwise
+        # block every future attempt (exists -> skip re-download -> fail).
+        if os.path.exists(archive):
+            try:
+                os.remove(archive)
+            except OSError:
+                pass
         return None
 
 
@@ -109,6 +119,13 @@ def load_cifar10(data_dir: str = "./data", synthetic_ok: bool = True) -> Arrays:
     if found is not None:
         return _load_from_dir(found)
     if synthetic_ok:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "CIFAR-10 not found under %r and download failed; using SYNTHETIC "
+            "data — accuracies will not be comparable to real CIFAR-10",
+            data_dir,
+        )
         return synthetic_cifar10()
     raise FileNotFoundError(
         f"CIFAR-10 not found under {data_dir!r} and download failed; "

@@ -14,6 +14,20 @@ from typing import Any, Callable, Dict, Optional
 from flax import linen as nn
 
 from pytorch_cifar_tpu.models.lenet import LeNet
+from pytorch_cifar_tpu.models.preact_resnet import (
+    PreActResNet18,
+    PreActResNet34,
+    PreActResNet50,
+    PreActResNet101,
+    PreActResNet152,
+)
+from pytorch_cifar_tpu.models.resnet import (
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
 
@@ -37,3 +51,13 @@ def available_models():
 
 
 register("LeNet", LeNet)
+register("ResNet18", ResNet18)
+register("ResNet34", ResNet34)
+register("ResNet50", ResNet50)
+register("ResNet101", ResNet101)
+register("ResNet152", ResNet152)
+register("PreActResNet18", PreActResNet18)
+register("PreActResNet34", PreActResNet34)
+register("PreActResNet50", PreActResNet50)
+register("PreActResNet101", PreActResNet101)
+register("PreActResNet152", PreActResNet152)

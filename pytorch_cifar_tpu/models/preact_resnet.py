@@ -1,0 +1,111 @@
+"""Pre-activation ResNet family (reference: models/preact_resnet.py:12-110).
+
+BN-ReLU-conv ordering; the projection shortcut branches off the
+*pre-activated* tensor and — unlike plain ResNet — has no BN of its own
+(models/preact_resnet.py:23-26). The reference creates the shortcut
+conditionally via ``hasattr`` (SURVEY.md §2.2); here the same condition is a
+plain shape check at trace time. No final BN/ReLU before the head, matching
+the reference forward (models/preact_resnet.py:85-94).
+
+Golden param counts (BASELINE.md): PreActResNet18 11,171,146 ·
+PreActResNet50 23.51M.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import BatchNorm, Conv, Dense, avg_pool
+
+
+class PreActBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+
+        pre = nn.relu(bn()(x))
+        needs_proj = self.stride != 1 or x.shape[-1] != self.expansion * self.planes
+        shortcut = (
+            conv(self.expansion * self.planes, 1, strides=self.stride)(pre)
+            if needs_proj
+            else x
+        )
+        out = conv(self.planes, 3, strides=self.stride, padding=1)(pre)
+        out = conv(self.planes, 3, padding=1)(nn.relu(bn()(out)))
+        return out + shortcut
+
+
+class PreActBottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+
+        pre = nn.relu(bn()(x))
+        needs_proj = self.stride != 1 or x.shape[-1] != self.expansion * self.planes
+        shortcut = (
+            conv(self.expansion * self.planes, 1, strides=self.stride)(pre)
+            if needs_proj
+            else x
+        )
+        out = conv(self.planes, 1)(pre)
+        out = conv(self.planes, 3, strides=self.stride, padding=1)(
+            nn.relu(bn()(out))
+        )
+        out = conv(self.expansion * self.planes, 1)(nn.relu(bn()(out)))
+        return out + shortcut
+
+
+class PreActResNet(nn.Module):
+    block: Any
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(64, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        for planes, stride, n in zip(
+            (64, 128, 256, 512), (1, 2, 2, 2), self.num_blocks
+        ):
+            for i in range(n):
+                x = self.block(
+                    planes, stride=stride if i == 0 else 1, dtype=self.dtype
+                )(x, train)
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def PreActResNet18(num_classes=10, dtype=None):
+    return PreActResNet(PreActBlock, (2, 2, 2, 2), num_classes, dtype)
+
+
+def PreActResNet34(num_classes=10, dtype=None):
+    return PreActResNet(PreActBlock, (3, 4, 6, 3), num_classes, dtype)
+
+
+def PreActResNet50(num_classes=10, dtype=None):
+    return PreActResNet(PreActBottleneck, (3, 4, 6, 3), num_classes, dtype)
+
+
+def PreActResNet101(num_classes=10, dtype=None):
+    return PreActResNet(PreActBottleneck, (3, 4, 23, 3), num_classes, dtype)
+
+
+def PreActResNet152(num_classes=10, dtype=None):
+    return PreActResNet(PreActBottleneck, (3, 8, 36, 3), num_classes, dtype)

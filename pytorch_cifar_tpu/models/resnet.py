@@ -1,0 +1,119 @@
+"""ResNet family for CIFAR-10 (reference: models/resnet.py:16-160).
+
+CIFAR adaptations carried over from the reference contract: 3x3 stride-1
+stem (no maxpool, models/resnet.py:102), stage widths 64/128/256/512 with
+strides 1/2/2/2 (models/resnet.py:105-108), 4x4 average pool head
+(models/resnet.py:127), single linear classifier.
+
+TPU-first differences: NHWC layout; the reference's per-block ``autocast``
+branches (models/resnet.py:38-51 — AMP plumbing duplicated through every
+forward) collapse into the module-level ``dtype`` policy: pass
+``dtype=jnp.bfloat16`` and every conv/BN computes in bf16 with fp32 params
+and fp32 BN statistics. Golden param counts (BASELINE.md): ResNet18
+11,173,962 · ResNet50 23,520,842 · ResNet152 58,156,618.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+)
+
+
+class BasicBlock(nn.Module):
+    """conv3x3-BN-ReLU-conv3x3-BN + projection shortcut, post-activation."""
+
+    planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+
+        out = conv(self.planes, 3, strides=self.stride, padding=1)(x)
+        out = nn.relu(bn()(out))
+        out = conv(self.planes, 3, padding=1)(out)
+        out = bn()(out)
+
+        if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
+            x = conv(self.expansion * self.planes, 1, strides=self.stride)(x)
+            x = bn()(x)
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    """1x1 reduce - 3x3 - 1x1 expand (x4), post-activation."""
+
+    planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+
+        out = nn.relu(bn()(conv(self.planes, 1)(x)))
+        out = nn.relu(bn()(conv(self.planes, 3, strides=self.stride, padding=1)(out)))
+        out = bn()(conv(self.expansion * self.planes, 1)(out))
+
+        if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
+            x = conv(self.expansion * self.planes, 1, strides=self.stride)(x)
+            x = bn()(x)
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    block: Any
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(64, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(
+            BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        )
+        for planes, stride, n in zip(
+            (64, 128, 256, 512), (1, 2, 2, 2), self.num_blocks
+        ):
+            for i in range(n):
+                x = self.block(
+                    planes, stride=stride if i == 0 else 1, dtype=self.dtype
+                )(x, train)
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def ResNet18(num_classes=10, dtype=None):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype)
+
+
+def ResNet34(num_classes=10, dtype=None):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, dtype)
+
+
+def ResNet50(num_classes=10, dtype=None):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype)
+
+
+def ResNet101(num_classes=10, dtype=None):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, dtype)
+
+
+def ResNet152(num_classes=10, dtype=None):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, dtype)

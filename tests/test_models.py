@@ -17,6 +17,27 @@ from pytorch_cifar_tpu.models.common import count_params
 # name -> golden param count (BASELINE.md / SURVEY.md §2.2)
 GOLDEN_PARAMS = {
     "LeNet": 62_006,
+    "ResNet18": 11_173_962,
+    "ResNet34": 21_282_122,
+    "ResNet50": 23_520_842,
+    "ResNet101": 42_512_970,
+    "ResNet152": 58_156_618,
+    "PreActResNet18": 11_171_146,
+    "PreActResNet34": 21_279_306,
+    "PreActResNet50": 23_509_066,
+    "PreActResNet101": 42_501_194,
+    "PreActResNet152": 58_144_842,
+}
+
+# Full init+forward of the deepest variants takes minutes on the CPU test
+# platform; run real forwards on one model per block type (basic/bottleneck,
+# post-/pre-activation) and cover the rest via eval_shape param counts.
+SHAPE_CHECKED = {
+    "LeNet",
+    "ResNet18",
+    "ResNet50",
+    "PreActResNet18",
+    "PreActResNet50",
 }
 
 
@@ -30,16 +51,37 @@ def init_model(name, batch=2):
 
 @pytest.mark.parametrize("name", sorted(GOLDEN_PARAMS))
 def test_param_count_golden(name):
-    _, variables = init_model(name)
+    # eval_shape traces init without allocating/computing: exact same param
+    # tree shapes, seconds instead of minutes for the 100+-layer variants.
+    model = create_model(name)
+    variables = jax.eval_shape(
+        lambda r: model.init(r, jnp.zeros((1, 32, 32, 3)), train=False),
+        jax.random.PRNGKey(0),
+    )
     assert count_params(variables["params"]) == GOLDEN_PARAMS[name]
 
 
-@pytest.mark.parametrize("name", sorted(GOLDEN_PARAMS))
+@pytest.mark.parametrize("name", sorted(SHAPE_CHECKED))
 def test_forward_shape(name):
     model, variables = init_model(name, batch=3)
     out = model.apply(variables, jnp.zeros((3, 32, 32, 3)), train=False)
     assert out.shape == (3, 10)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+@pytest.mark.parametrize("name", ["ResNet18", "PreActResNet18"])
+def test_batch_stats_update_in_train_mode(name):
+    model, variables = init_model(name)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    out, mutated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert out.shape == (4, 10)
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(old, new)
+    )
 
 
 def test_registry_contains_all_models():
