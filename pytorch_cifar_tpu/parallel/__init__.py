@@ -1,0 +1,19 @@
+"""SPMD parallelism over a jax.sharding.Mesh.
+
+TPU-native replacement for the reference's two data-parallel flavors
+(DataParallel main.py:73-75; DDP/NCCL main_dist.py:140-144) — one SPMD
+code path covers both.
+"""
+
+from pytorch_cifar_tpu.parallel.mesh import (
+    DATA_AXIS,
+    initialize_distributed,
+    make_mesh,
+)
+from pytorch_cifar_tpu.parallel.dp import (
+    batch_sharding,
+    data_parallel_eval_step,
+    data_parallel_train_step,
+    replicate,
+    unreplicate,
+)

@@ -1,0 +1,70 @@
+"""Device mesh construction + multi-host initialization.
+
+The reference's topology plumbing — TCP rendezvous URL, node-rank math,
+one process per GPU (main_dist.py:39-40,51-76) — is replaced by the JAX
+model: the TPU runtime handles rendezvous (`jax.distributed.initialize()`
+needs no URL on TPU pods), one process per host drives all local chips,
+and the "world" is a named mesh axis that XLA lowers collectives onto
+(ICI within a slice, DCN across slices).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host rendezvous (replaces dist.init_process_group,
+    main_dist.py:73-74).
+
+    On TPU pods every argument is discovered from the runtime environment;
+    the explicit arguments exist for CPU/GPU multi-process testing. Safe to
+    call in single-process runs (no-op if already initialized or
+    single-host).
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # single-host / already-initialized: SPMD code below works unchanged
+        pass
+
+
+def make_mesh(
+    num_devices: int = 0,
+    axis: str = DATA_AXIS,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D data-parallel mesh over the first ``num_devices`` devices
+    (0 = all addressable devices; the reference's implicit
+    ``device_count()`` world, main_dist.py:54)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def is_primary() -> bool:
+    """True on the process that owns logging/checkpoint writes (the SPMD
+    equivalent of the reference's rank-0 gating, main_dist.py:78-82,243)."""
+    return jax.process_index() == 0
