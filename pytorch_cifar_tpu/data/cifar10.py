@@ -56,8 +56,11 @@ def _find_dataset(data_dir: str):
     env = os.environ.get("CIFAR10_PATH")
     if env:
         candidates.insert(0, env)
+    required = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
     for c in candidates:
-        if os.path.isfile(os.path.join(c, "data_batch_1")):
+        # all six batch files must exist — a partially-extracted directory
+        # (e.g. ENOSPC mid-extraction) must not be mistaken for the dataset
+        if all(os.path.isfile(os.path.join(c, f)) for f in required):
             return c
     return None
 
@@ -77,14 +80,18 @@ def _try_download(data_dir: str):
             else:  # pragma: no cover - pre-3.12
                 tf.extractall(data_dir)
         return os.path.join(data_dir, _DIRNAME)
-    except Exception:
+    except (tarfile.ReadError, EOFError):
         # A truncated archive from an interrupted download would otherwise
         # block every future attempt (exists -> skip re-download -> fail).
+        # Only corrupt-archive errors trigger removal; transient failures
+        # (disk full, permissions) must not destroy a valid archive.
         if os.path.exists(archive):
             try:
                 os.remove(archive)
             except OSError:
                 pass
+        return None
+    except Exception:
         return None
 
 

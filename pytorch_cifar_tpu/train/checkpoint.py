@@ -1,0 +1,102 @@
+"""Checkpoint save/restore of the full TrainState.
+
+Strictly more complete than the reference's 3-key dict (net/acc/epoch,
+main.py:140-147): params, BN batch_stats, optimizer state (momentum
+buffers), step, epoch, and best_acc all round-trip, so a resumed run
+continues the exact momentum + LR trajectory (the reference restarts both,
+SURVEY.md §3.4). Same best-accuracy gating semantics (main.py:136-148).
+
+Format: flax msgpack of the array pytree + a JSON sidecar for scalars.
+Writes are atomic (tmp + rename) and process-0-only under multi-host SPMD
+(rank-0 gating parity, main_dist.py:243).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from pytorch_cifar_tpu.train.state import TrainState
+
+CKPT_NAME = "ckpt.msgpack"
+META_NAME = "ckpt.json"
+
+
+def save_checkpoint(
+    output_dir: str,
+    state: TrainState,
+    epoch: int,
+    best_acc: float,
+    name: str = CKPT_NAME,
+) -> Optional[str]:
+    """Write state to ``output_dir`` (process 0 only). Returns the path."""
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(output_dir, exist_ok=True)
+    # one logical copy on host; works for replicated or single-device state
+    host_state = jax.device_get(
+        {
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+            "step": state.step,
+        }
+    )
+    payload = serialization.to_bytes(host_state)
+    path = os.path.join(output_dir, name)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+    meta = {"epoch": int(epoch), "best_acc": float(best_acc)}
+    meta_path = os.path.join(output_dir, META_NAME)
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, meta_path)
+    return path
+
+
+def restore_checkpoint(
+    output_dir: str, state: TrainState, name: str = CKPT_NAME
+) -> Tuple[TrainState, int, float]:
+    """Load ``output_dir``'s checkpoint into ``state``'s structure.
+
+    Returns (state, start_epoch, best_acc); start_epoch is the next epoch to
+    run (saved epoch + 1).
+    """
+    path = os.path.join(output_dir, name)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no checkpoint at {path!r} — run without --resume first "
+            "(parity: main.py:79 asserts ./checkpoint exists)"
+        )
+    with open(path, "rb") as f:
+        payload = f.read()
+    target = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+        "step": np.zeros((), np.int32),
+    }
+    restored = serialization.from_bytes(target, payload)
+    state = state.replace(
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+        step=restored["step"],
+    )
+    meta_path = os.path.join(output_dir, META_NAME)
+    epoch, best_acc = -1, 0.0
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        epoch = int(meta.get("epoch", -1))
+        best_acc = float(meta.get("best_acc", 0.0))
+    return state, epoch + 1, best_acc

@@ -1,0 +1,239 @@
+"""Trainer: the reference's L4/L5 driver rebuilt TPU-first.
+
+One Trainer covers both reference entry points (single-node main.py:92-154
+and distributed main_dist.py:51-261): the device count is a mesh property,
+not a code path. Epoch loop semantics match the reference — train over
+shuffled shards, full eval, best-acc-gated checkpoint, per-epoch cosine LR
+(stepped implicitly via the step-indexed schedule, optim.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_cifar_tpu.config import TrainConfig
+from pytorch_cifar_tpu.data.cifar10 import load_cifar10, synthetic_cifar10
+from pytorch_cifar_tpu.data.pipeline import Dataloader, eval_batches
+from pytorch_cifar_tpu.models import create_model
+from pytorch_cifar_tpu.parallel import (
+    DATA_AXIS,
+    batch_sharding,
+    data_parallel_eval_step,
+    data_parallel_train_step,
+    initialize_distributed,
+    make_mesh,
+    replicate,
+)
+from pytorch_cifar_tpu.parallel.mesh import is_primary
+from pytorch_cifar_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+from pytorch_cifar_tpu.train.optim import make_optimizer
+from pytorch_cifar_tpu.train.state import TrainState, create_train_state
+from pytorch_cifar_tpu.train.steps import make_eval_step, make_train_step
+from pytorch_cifar_tpu.utils import progress_bar, set_logger
+
+log = logging.getLogger(__name__)
+
+
+class Trainer:
+    def __init__(self, config: TrainConfig):
+        self.config = config
+        if config.distributed:
+            initialize_distributed()
+
+        # -- data ------------------------------------------------------
+        if config.synthetic_data:
+            tr_x, tr_y, te_x, te_y = synthetic_cifar10()
+        else:
+            tr_x, tr_y, te_x, te_y = load_cifar10(config.data_dir)
+        self.train_images, self.train_labels = tr_x, tr_y
+        self.test_images, self.test_labels = te_x, te_y
+
+        # -- mesh ------------------------------------------------------
+        self.mesh = make_mesh(config.num_devices)
+        n_dev = self.mesh.devices.size
+        if config.batch_size % n_dev:
+            # parity with main_dist.py:112-115's divisibility warning
+            log.warning(
+                "batch_size %d not divisible by %d devices; rounding down",
+                config.batch_size,
+                n_dev,
+            )
+        self.global_batch = max(config.batch_size // n_dev, 1) * n_dev
+        eval_bs = max(config.eval_batch_size // n_dev, 1) * n_dev
+
+        sharding = batch_sharding(self.mesh)
+        self.loader = Dataloader(
+            tr_x,
+            tr_y,
+            batch_size=self.global_batch,
+            shuffle=True,
+            seed=config.seed,
+            sharding=sharding,
+        )
+        self.eval_bs = eval_bs
+        self.sharding = sharding
+        self.steps_per_epoch = len(self.loader)
+
+        # -- model/optimizer/state ------------------------------------
+        self.model = create_model(
+            config.model,
+            num_classes=config.num_classes,
+            dtype=jnp.bfloat16 if config.amp else None,
+        )
+        self.tx = make_optimizer(
+            lr=config.lr,
+            momentum=config.momentum,
+            weight_decay=config.weight_decay,
+            t_max=config.t_max,
+            steps_per_epoch=self.steps_per_epoch,
+        )
+        state = create_train_state(
+            self.model, jax.random.PRNGKey(config.seed), self.tx
+        )
+
+        self.start_epoch = 0
+        self.best_acc = 0.0
+        if config.resume:
+            state, self.start_epoch, self.best_acc = restore_checkpoint(
+                config.output_dir, state
+            )
+            log.info(
+                "resumed from %s: epoch %d, best_acc %.2f",
+                config.output_dir,
+                self.start_epoch,
+                self.best_acc,
+            )
+        self.state = replicate(state, self.mesh)
+
+        # -- compiled steps -------------------------------------------
+        compute = jnp.bfloat16 if config.amp else jnp.float32
+        self.train_step = data_parallel_train_step(
+            make_train_step(
+                crop=config.random_crop,
+                flip=config.random_flip,
+                mean=config.mean,
+                std=config.std,
+                compute_dtype=compute,
+                axis_name=DATA_AXIS,
+            ),
+            self.mesh,
+        )
+        self.eval_step = data_parallel_eval_step(
+            make_eval_step(
+                mean=config.mean,
+                std=config.std,
+                compute_dtype=compute,
+                axis_name=DATA_AXIS,
+            ),
+            self.mesh,
+        )
+        self.rng = jax.random.PRNGKey(config.seed + 1)
+
+    # ------------------------------------------------------------------
+
+    def train_epoch(self, epoch: int) -> Tuple[float, float]:
+        log.info("\nEpoch: %d", epoch)
+        state = self.state
+        loss_sum = correct = count = 0.0
+        nb = self.steps_per_epoch
+        # fold the epoch into the rng: deterministic, distinct shuffles &
+        # augmentations per epoch (the reference's missing set_epoch fix)
+        rng = jax.random.fold_in(self.rng, epoch)
+        t0 = time.time()
+        for i, batch in enumerate(self.loader.epoch(epoch)):
+            state, metrics = self.train_step(state, batch, rng)
+            if (
+                i % self.config.log_every == 0
+                or i + 1 == nb
+                or sys.stdout.isatty()
+            ):
+                # pulling metrics syncs; on TTY match the reference's
+                # per-step bar, otherwise only every log_every steps
+                m = jax.device_get(metrics)
+                loss_sum = float(m["loss_sum"])
+                correct = float(m["correct"])
+                count = float(m["count"])
+                if is_primary():
+                    progress_bar(
+                        i,
+                        nb,
+                        "Loss: %.3f | Acc: %.3f%% (%d/%d)"
+                        % (
+                            loss_sum / max(count, 1),
+                            100.0 * correct / max(count, 1),
+                            int(correct),
+                            int(count),
+                        ),
+                        log_every=self.config.log_every,
+                    )
+        self.state = state
+        dt = time.time() - t0
+        imgs = nb * self.global_batch
+        log.info(
+            "train epoch %d: loss %.4f acc %.2f%% (%.0f img/s)",
+            epoch,
+            loss_sum / max(count, 1),
+            100.0 * correct / max(count, 1),
+            imgs / max(dt, 1e-9),
+        )
+        return loss_sum / max(count, 1), 100.0 * correct / max(count, 1)
+
+    def eval_epoch(self, epoch: int) -> Tuple[float, float]:
+        loss_sum = correct = count = 0.0
+        for x, y in eval_batches(
+            self.test_images, self.test_labels, self.eval_bs
+        ):
+            batch = (
+                jax.device_put(x, self.sharding),
+                jax.device_put(y, self.sharding),
+            )
+            m = jax.device_get(self.eval_step(self.state, batch))
+            loss_sum += float(m["loss_sum"])
+            correct += float(m["correct"])
+            count += float(m["count"])
+        acc = 100.0 * correct / max(count, 1)
+        log.info(
+            "eval  epoch %d: loss %.4f acc %.2f%%",
+            epoch,
+            loss_sum / max(count, 1),
+            acc,
+        )
+        return loss_sum / max(count, 1), acc
+
+    def maybe_checkpoint(self, epoch: int, acc: float) -> bool:
+        if acc > self.best_acc:
+            self.best_acc = acc
+            log.info("Saving.. (best acc %.2f%%)", acc)
+            save_checkpoint(
+                self.config.output_dir, self.state, epoch, self.best_acc
+            )
+            return True
+        return False
+
+    def fit(self) -> float:
+        cfg = self.config
+        if is_primary():
+            set_logger(
+                None
+                if not cfg.output_dir
+                else f"{cfg.output_dir}/train.log"
+            )
+        log.info(
+            "==> model %s | %d devices | global batch %d | %d steps/epoch",
+            cfg.model,
+            self.mesh.devices.size,
+            self.global_batch,
+            self.steps_per_epoch,
+        )
+        for epoch in range(self.start_epoch, cfg.epochs):
+            self.train_epoch(epoch)
+            _, acc = self.eval_epoch(epoch)
+            self.maybe_checkpoint(epoch, acc)
+        return self.best_acc

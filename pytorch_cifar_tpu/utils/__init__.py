@@ -1,0 +1,2 @@
+from pytorch_cifar_tpu.utils.logging import set_logger
+from pytorch_cifar_tpu.utils.progress import format_time, progress_bar

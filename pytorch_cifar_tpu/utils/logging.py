@@ -1,0 +1,39 @@
+"""File + console logging (parity: utils.py:128-141, installed
+main_dist.py:88)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+
+def set_logger(log_path: Optional[str] = None) -> logging.Logger:
+    """Configure the root logger with a console handler and, when
+    ``log_path`` is given, a file handler. Idempotent."""
+    logger = logging.getLogger()
+    logger.setLevel(logging.INFO)
+
+    have_stream = any(
+        type(h) is logging.StreamHandler for h in logger.handlers
+    )
+    if not have_stream:
+        sh = logging.StreamHandler()
+        sh.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(sh)
+
+    if log_path:
+        log_path = os.path.abspath(log_path)
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        have_file = any(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == log_path
+            for h in logger.handlers
+        )
+        if not have_file:
+            fh = logging.FileHandler(log_path)
+            fh.setFormatter(
+                logging.Formatter("%(asctime)s:%(levelname)s: %(message)s")
+            )
+            logger.addHandler(fh)
+    return logger

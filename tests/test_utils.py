@@ -1,0 +1,48 @@
+"""Observability utils: TTY-safe progress bar + logger idempotence."""
+
+import io
+import logging
+
+from pytorch_cifar_tpu.utils import format_time, progress_bar, set_logger
+
+
+def test_format_time_units():
+    assert format_time(0) == "0ms"
+    assert format_time(0.5) == "500ms"
+    assert format_time(61) == "1m1s"
+    assert format_time(3661) == "1h1m"
+    assert format_time(90000) == "1D1h"
+
+
+def test_progress_bar_non_tty_writes_periodic_lines():
+    buf = io.StringIO()  # not a TTY -> plain lines, no \r control codes
+    for i in range(100):
+        progress_bar(i, 100, "Loss: 1.0", stream=buf, log_every=50)
+    out = buf.getvalue()
+    assert "\r" not in out
+    lines = out.strip().split("\n")
+    assert len(lines) == 3  # steps 0, 50, 99
+    assert "[100/100]" in lines[-1]
+    assert "Loss: 1.0" in lines[-1]
+
+
+def test_progress_bar_tty_renders_bar():
+    class Tty(io.StringIO):
+        def isatty(self):
+            return True
+
+    buf = Tty()
+    progress_bar(0, 10, "x", stream=buf)
+    assert "\r" in buf.getvalue()
+    assert ">" in buf.getvalue()
+
+
+def test_set_logger_idempotent(tmp_path):
+    path = str(tmp_path / "train.log")
+    logger = set_logger(path)
+    n = len(logger.handlers)
+    logger2 = set_logger(path)
+    assert len(logger2.handlers) == n  # no duplicate handlers
+    logging.info("hello file")
+    with open(path) as f:
+        assert "hello file" in f.read()

@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""CIFAR-10 training CLI (the reference's main.py/main_dist.py unified).
+
+Examples:
+    python train.py                                 # ResNet18, 1 chip/all chips
+    python train.py --model ResNet50 --batch_size 1024
+    python train.py --resume --output_dir ./checkpoint
+    python train.py --synthetic_data --epochs 2     # no-dataset smoke run
+"""
+
+from pytorch_cifar_tpu.config import parse_config
+from pytorch_cifar_tpu.train.trainer import Trainer
+from pytorch_cifar_tpu.utils import set_logger
+
+
+def main(argv=None) -> float:
+    config = parse_config(argv)
+    set_logger(
+        f"{config.output_dir}/train.log" if config.output_dir else None
+    )
+    trainer = Trainer(config)
+    best = trainer.fit()
+    print(f"best test accuracy: {best:.2f}%")
+    return best
+
+
+if __name__ == "__main__":
+    main()
