@@ -28,6 +28,10 @@ from pytorch_cifar_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
+from pytorch_cifar_tpu.models.vgg import VGG11, VGG13, VGG16, VGG19
+from pytorch_cifar_tpu.models.mobilenet import MobileNet
+from pytorch_cifar_tpu.models.mobilenetv2 import MobileNetV2
+from pytorch_cifar_tpu.models.senet import SENet18
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
 
@@ -61,3 +65,10 @@ register("PreActResNet34", PreActResNet34)
 register("PreActResNet50", PreActResNet50)
 register("PreActResNet101", PreActResNet101)
 register("PreActResNet152", PreActResNet152)
+register("VGG11", VGG11)
+register("VGG13", VGG13)
+register("VGG16", VGG16)
+register("VGG19", VGG19)
+register("MobileNet", MobileNet)
+register("MobileNetV2", MobileNetV2)
+register("SENet18", SENet18)

@@ -1,0 +1,58 @@
+"""MobileNetV1 for CIFAR-10 (reference: models/mobilenet.py:11-58).
+
+Depthwise-separable blocks: 3x3 depthwise (groups=channels,
+models/mobilenet.py:15) + 1x1 pointwise, each conv-BN-ReLU. Stem conv3x3
+stride 1 to 32ch (models/mobilenet.py:33); width/stride plan from the cfg
+list (models/mobilenet.py:28); 2x2 average-pool head then 1024->classes
+linear (models/mobilenet.py:50-53).
+
+Depthwise convs on TPU use ``feature_group_count`` (SURVEY.md §7.6 hard part
+#3); XLA lowers them to vector-unit ops rather than MXU matmuls, which is
+the expected behavior for this family. Golden param count: 3,217,226.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import BatchNorm, Conv, Dense, avg_pool
+
+# int = (planes, stride 1); tuple = (planes, stride)
+_CFG = (64, (128, 2), 128, (256, 2), 256, (512, 2), 512, 512, 512, 512, 512,
+        (1024, 2), 1024)
+
+
+class DepthwiseSeparable(nn.Module):
+    """3x3 depthwise + 1x1 pointwise, each followed by BN-ReLU."""
+
+    planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        in_ch = x.shape[-1]
+        bn = lambda: BatchNorm(use_running_average=not train, dtype=self.dtype)
+        x = Conv(in_ch, 3, strides=self.stride, padding=1, groups=in_ch,
+                 use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        x = Conv(self.planes, 1, use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(bn()(x))
+
+
+class MobileNet(nn.Module):
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(32, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        for item in _CFG:
+            planes, stride = (item, 1) if isinstance(item, int) else item
+            x = DepthwiseSeparable(planes, stride, dtype=self.dtype)(x, train)
+        x = avg_pool(x, 2)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)

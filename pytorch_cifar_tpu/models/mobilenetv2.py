@@ -1,0 +1,83 @@
+"""MobileNetV2 for CIFAR-10 (reference: models/mobilenetv2.py:11-80).
+
+Inverted residual blocks: 1x1 expand -> 3x3 depthwise -> 1x1 linear project
+(models/mobilenetv2.py:20-27). Residual add only when stride==1
+(models/mobilenetv2.py:36), with a 1x1 conv+BN projection shortcut when the
+channel count changes (models/mobilenetv2.py:26-30) — note the reference
+keeps the expand conv even for expansion=1 in stage one, unlike the paper.
+CIFAR adaptations preserved: stem stride 1 and stage-2 stride lowered 2->1
+(comments models/mobilenetv2.py:43,52); 4x4 avg-pool head; 320->1280 1x1
+conv before the classifier (models/mobilenetv2.py:56-58,73-77).
+
+Golden param count: 2,296,922.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import BatchNorm, Conv, Dense, avg_pool
+
+# (expansion, out_planes, num_blocks, stride) per stage
+_CFG = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 1),  # stride 2 -> 1 for CIFAR (reference models/mobilenetv2.py:43)
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+class InvertedResidual(nn.Module):
+    """expand 1x1 -> depthwise 3x3 -> project 1x1 (linear), residual if s==1."""
+
+    planes: int
+    expansion: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        in_ch = x.shape[-1]
+        mid = self.expansion * in_ch
+        bn = lambda: BatchNorm(use_running_average=not train, dtype=self.dtype)
+
+        out = Conv(mid, 1, use_bias=False, dtype=self.dtype)(x)
+        out = nn.relu(bn()(out))
+        out = Conv(mid, 3, strides=self.stride, padding=1, groups=mid,
+                   use_bias=False, dtype=self.dtype)(out)
+        out = nn.relu(bn()(out))
+        out = Conv(self.planes, 1, use_bias=False, dtype=self.dtype)(out)
+        out = bn()(out)
+
+        if self.stride == 1:
+            if in_ch != self.planes:
+                x = Conv(self.planes, 1, use_bias=False, dtype=self.dtype)(x)
+                x = bn()(x)
+            out = out + x
+        return out
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bn = lambda: BatchNorm(use_running_average=not train, dtype=self.dtype)
+        x = Conv(32, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        for expansion, planes, num_blocks, stride in _CFG:
+            for i in range(num_blocks):
+                x = InvertedResidual(
+                    planes, expansion, stride if i == 0 else 1, dtype=self.dtype
+                )(x, train)
+        x = Conv(1280, 1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)

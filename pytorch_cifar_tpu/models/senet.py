@@ -1,0 +1,77 @@
+"""SENet18 for CIFAR-10 (reference: models/senet.py:45-115).
+
+Pre-activation basic blocks with squeeze-and-excitation channel gating: the
+SE branch is global average pool to 1x1 (models/senet.py:64), two 1x1 convs
+with bias (reduction 16, models/senet.py:59-60), ReLU then sigmoid, and a
+broadcast multiply (models/senet.py:65-69). The conditional projection
+shortcut taken from the *pre-activated* input mirrors models/senet.py:53-57,
+including the hasattr idiom (here: an explicit condition) and the shortcut
+having no BN. Stage plan 64/128/256/512, strides 1/2/2/2, avg_pool(4) head
+(models/senet.py:85-106).
+
+Golden param count: SENet18 11,260,354.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import BatchNorm, Conv, Dense, avg_pool
+
+
+class SEPreActBlock(nn.Module):
+    """BN-ReLU-conv3x3 -> BN-ReLU-conv3x3, SE gate, residual add."""
+
+    planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = lambda n, k, s=1, p=0: Conv(
+            n, k, strides=s, padding=p, use_bias=False, dtype=self.dtype
+        )
+        bn = lambda: BatchNorm(use_running_average=not train, dtype=self.dtype)
+
+        out = nn.relu(bn()(x))
+        shortcut = (
+            conv(self.planes, 1, self.stride)(out)
+            if self.stride != 1 or x.shape[-1] != self.planes
+            else x
+        )
+        out = conv(self.planes, 3, self.stride, 1)(out)
+        out = conv(self.planes, 3, 1, 1)(nn.relu(bn()(out)))
+
+        # Squeeze: global average pool; excitation: 1x1 convs w/ bias.
+        w = jnp.mean(out, axis=(1, 2), keepdims=True)
+        w = nn.relu(Conv(self.planes // 16, 1, dtype=self.dtype)(w))
+        w = nn.sigmoid(Conv(self.planes, 1, dtype=self.dtype)(w))
+        return out * w + shortcut
+
+
+class SENet(nn.Module):
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(64, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        for planes, stride, n in zip(
+            (64, 128, 256, 512), (1, 2, 2, 2), self.num_blocks
+        ):
+            for i in range(n):
+                x = SEPreActBlock(
+                    planes, stride if i == 0 else 1, dtype=self.dtype
+                )(x, train)
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def SENet18(num_classes=10, dtype=None):
+    return SENet((2, 2, 2, 2), num_classes, dtype)

@@ -1,0 +1,61 @@
+"""VGG11/13/16/19 for CIFAR-10 (reference: models/vgg.py:6-40).
+
+Config-list driven conv3x3(+bias)-BN-ReLU stacks; ``'M'`` entries are 2x2
+stride-2 max pools (models/vgg.py:29-37); a single 512->num_classes linear
+head (models/vgg.py:18). The reference's trailing AvgPool2d(kernel=1,
+stride=1) (models/vgg.py:38) is an identity op and is dropped here. NHWC,
+module-level dtype policy instead of no mixed-precision support.
+
+Golden param counts: VGG11 9,231,114 · VGG13 9,416,010 · VGG16 14,728,266 ·
+VGG19 20,040,522.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import BatchNorm, Conv, Dense, max_pool
+
+CFG = {
+    "VGG11": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "VGG13": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512,
+              512, "M"),
+    "VGG16": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+              "M", 512, 512, 512, "M"),
+    "VGG19": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+              512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for item in self.cfg:
+            if item == "M":
+                x = max_pool(x, 2)
+            else:
+                x = Conv(item, 3, padding=1, dtype=self.dtype)(x)
+                x = BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def _factory(name):
+    def make(num_classes=10, dtype=None):
+        return VGG(CFG[name], num_classes, dtype)
+
+    make.__name__ = name
+    return make
+
+
+VGG11 = _factory("VGG11")
+VGG13 = _factory("VGG13")
+VGG16 = _factory("VGG16")
+VGG19 = _factory("VGG19")

@@ -72,31 +72,58 @@ def restore_checkpoint(
     run (saved epoch + 1).
     """
     path = os.path.join(output_dir, name)
-    if not os.path.isfile(path):
+    multihost = jax.process_count() > 1
+    # Saves are process-0-only, so under multi-host without a shared
+    # filesystem only process 0 sees the file. Process 0 decides whether a
+    # checkpoint exists and every process follows that decision, then the
+    # restored arrays are broadcast — no per-host file requirement, and no
+    # host can diverge (raise vs proceed) and deadlock the collective job.
+    have_ckpt = os.path.isfile(path)
+    if multihost:
+        from jax.experimental import multihost_utils
+
+        have_ckpt = bool(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(have_ckpt, np.int32)
+            )
+        )
+    if not have_ckpt:
         raise FileNotFoundError(
             f"no checkpoint at {path!r} — run without --resume first "
             "(parity: main.py:79 asserts ./checkpoint exists)"
         )
-    with open(path, "rb") as f:
-        payload = f.read()
+
     target = {
         "params": jax.device_get(state.params),
         "batch_stats": jax.device_get(state.batch_stats),
         "opt_state": jax.device_get(state.opt_state),
         "step": np.zeros((), np.int32),
     }
-    restored = serialization.from_bytes(target, payload)
+    epoch, best_acc = -1, 0.0
+    if jax.process_index() == 0:
+        with open(path, "rb") as f:
+            payload = f.read()
+        restored = serialization.from_bytes(target, payload)
+        meta_path = os.path.join(output_dir, META_NAME)
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            epoch = int(meta.get("epoch", -1))
+            best_acc = float(meta.get("best_acc", 0.0))
+    else:
+        restored = target  # placeholder structure; overwritten by broadcast
+    if multihost:
+        from jax.experimental import multihost_utils
+
+        restored, scalars = multihost_utils.broadcast_one_to_all(
+            (restored, np.asarray([epoch, best_acc], np.float64))
+        )
+        epoch, best_acc = int(scalars[0]), float(scalars[1])
+
     state = state.replace(
         params=restored["params"],
         batch_stats=restored["batch_stats"],
         opt_state=restored["opt_state"],
         step=restored["step"],
     )
-    meta_path = os.path.join(output_dir, META_NAME)
-    epoch, best_acc = -1, 0.0
-    if os.path.isfile(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-        epoch = int(meta.get("epoch", -1))
-        best_acc = float(meta.get("best_acc", 0.0))
     return state, epoch + 1, best_acc

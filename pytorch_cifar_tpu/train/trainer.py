@@ -12,11 +12,10 @@ from __future__ import annotations
 import logging
 import sys
 import time
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from pytorch_cifar_tpu.config import TrainConfig
 from pytorch_cifar_tpu.data.cifar10 import load_cifar10, synthetic_cifar10
@@ -142,6 +141,7 @@ class Trainer:
         log.info("\nEpoch: %d", epoch)
         state = self.state
         loss_sum = correct = count = 0.0
+        totals = None  # on-device running sums; stays async until displayed
         nb = self.steps_per_epoch
         # fold the epoch into the rng: deterministic, distinct shuffles &
         # augmentations per epoch (the reference's missing set_epoch fix)
@@ -149,6 +149,11 @@ class Trainer:
         t0 = time.time()
         for i, batch in enumerate(self.loader.epoch(epoch)):
             state, metrics = self.train_step(state, batch, rng)
+            totals = (
+                metrics
+                if totals is None
+                else jax.tree_util.tree_map(jnp.add, totals, metrics)
+            )
             if (
                 i % self.config.log_every == 0
                 or i + 1 == nb
@@ -156,7 +161,7 @@ class Trainer:
             ):
                 # pulling metrics syncs; on TTY match the reference's
                 # per-step bar, otherwise only every log_every steps
-                m = jax.device_get(metrics)
+                m = jax.device_get(totals)
                 loss_sum = float(m["loss_sum"])
                 correct = float(m["correct"])
                 count = float(m["count"])

@@ -27,6 +27,13 @@ GOLDEN_PARAMS = {
     "PreActResNet50": 23_509_066,
     "PreActResNet101": 42_501_194,
     "PreActResNet152": 58_144_842,
+    "VGG11": 9_231_114,
+    "VGG13": 9_416_010,
+    "VGG16": 14_728_266,
+    "VGG19": 20_040_522,
+    "MobileNet": 3_217_226,
+    "MobileNetV2": 2_296_922,
+    "SENet18": 11_260_354,
 }
 
 # Full init+forward of the deepest variants takes minutes on the CPU test
@@ -38,6 +45,10 @@ SHAPE_CHECKED = {
     "ResNet50",
     "PreActResNet18",
     "PreActResNet50",
+    "VGG11",
+    "MobileNet",
+    "MobileNetV2",
+    "SENet18",
 }
 
 

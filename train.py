@@ -10,14 +10,11 @@ Examples:
 
 from pytorch_cifar_tpu.config import parse_config
 from pytorch_cifar_tpu.train.trainer import Trainer
-from pytorch_cifar_tpu.utils import set_logger
 
 
 def main(argv=None) -> float:
     config = parse_config(argv)
-    set_logger(
-        f"{config.output_dir}/train.log" if config.output_dir else None
-    )
+    # logger setup is owned by Trainer.fit(), gated to the primary process
     trainer = Trainer(config)
     best = trainer.fit()
     print(f"best test accuracy: {best:.2f}%")
