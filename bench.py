@@ -1,0 +1,118 @@
+"""North-star benchmark: ResNet-18 CIFAR-10 training throughput, images/sec/chip.
+
+BASELINE.json defines the metric (images/sec/chip, ResNet-18, CIFAR-10) and
+config 2 (single chip, batch 512). The reference publishes no numbers
+(BASELINE.json: "published": {}), so ``vs_baseline`` is reported as 1.0 — there
+is no reference value to divide by; the driver's BENCH_r{N}.json history is
+the comparison series across rounds.
+
+What is timed: the full jitted training iteration exactly as the trainer runs
+it — on-device uint8 decode + random-crop/flip augmentation, bf16 forward,
+loss, backward, SGD+momentum+wd+cosine update, metric accumulation — with
+donated state, over pre-staged device batches (isolates device throughput,
+the per-chip metric; the host input pipeline is benchmarked separately by
+tests/test_data.py and scales with host cores, not chips).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_step(model_name: str, batch: int, compute_dtype):
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+    from pytorch_cifar_tpu.train.steps import make_train_step
+
+    model = create_model(model_name, dtype=compute_dtype)
+    tx = make_optimizer(lr=0.1, t_max=200, steps_per_epoch=max(1, 50_000 // batch))
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    step = jax.jit(
+        make_train_step(compute_dtype=compute_dtype), donate_argnums=(0,)
+    )
+    return state, step
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="ResNet18")
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--warmup", type=int, default=10)
+    parser.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    args = parser.parse_args()
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # local smoke only; the driver benches on a real chip
+        args.batch = min(args.batch, 128)
+        args.steps = min(args.steps, 4)
+        args.warmup = min(args.warmup, 2)
+
+    compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    state, step = build_step(args.model, args.batch, compute_dtype)
+
+    # Pre-staged device batches (synthetic uint8 CIFAR shapes; throughput is
+    # content-independent). A few distinct buffers so no step reuses a
+    # donated input.
+    rs = np.random.RandomState(0)
+    batches = [
+        (
+            jax.device_put(
+                rs.randint(0, 256, size=(args.batch, 32, 32, 3), dtype=np.uint8)
+            ),
+            jax.device_put(rs.randint(0, 10, size=(args.batch,)).astype(np.int32)),
+        )
+        for _ in range(4)
+    ]
+    rng = jax.random.PRNGKey(42)
+
+    # Sync via D2H fetch of a metric: under some remote-TPU transports
+    # (axon tunnel) block_until_ready returns before execution finishes, but a
+    # device->host value transfer cannot. Steps chain through the donated
+    # state, so fetching the last step's metric waits for the whole run.
+    metrics = None
+    for i in range(args.warmup):
+        state, metrics = step(state, batches[i % len(batches)], rng)
+    if metrics is not None:
+        float(metrics["loss_sum"])
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = step(state, batches[i % len(batches)], rng)
+    loss_sum = float(metrics["loss_sum"])
+    elapsed = time.perf_counter() - t0
+
+    loss = loss_sum / float(metrics["count"])
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    n_chips = max(1, len([d for d in jax.devices() if d.platform == platform]))
+    images_per_sec = args.steps * args.batch / elapsed
+    value = images_per_sec / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": f"train_throughput_{args.model}_b{args.batch}_{args.dtype}_{platform}",
+                "value": round(value, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

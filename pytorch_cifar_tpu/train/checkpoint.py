@@ -73,6 +73,8 @@ def restore_checkpoint(
     """
     path = os.path.join(output_dir, name)
     multihost = jax.process_count() > 1
+    if multihost:
+        from jax.experimental import multihost_utils
     # Saves are process-0-only, so under multi-host without a shared
     # filesystem only process 0 sees the file. Process 0 decides whether a
     # checkpoint exists and every process follows that decision, then the
@@ -80,8 +82,6 @@ def restore_checkpoint(
     # host can diverge (raise vs proceed) and deadlock the collective job.
     have_ckpt = os.path.isfile(path)
     if multihost:
-        from jax.experimental import multihost_utils
-
         have_ckpt = bool(
             multihost_utils.broadcast_one_to_all(
                 np.asarray(have_ckpt, np.int32)
@@ -113,8 +113,6 @@ def restore_checkpoint(
     else:
         restored = target  # placeholder structure; overwritten by broadcast
     if multihost:
-        from jax.experimental import multihost_utils
-
         restored, scalars = multihost_utils.broadcast_one_to_all(
             (restored, np.asarray([epoch, best_acc], np.float64))
         )

@@ -45,6 +45,10 @@ class Trainer:
         self.config = config
         if config.distributed:
             initialize_distributed()
+        if is_primary():
+            set_logger(
+                f"{config.output_dir}/train.log" if config.output_dir else None
+            )
 
         # -- data ------------------------------------------------------
         if config.synthetic_data:
@@ -224,12 +228,6 @@ class Trainer:
 
     def fit(self) -> float:
         cfg = self.config
-        if is_primary():
-            set_logger(
-                None
-                if not cfg.output_dir
-                else f"{cfg.output_dir}/train.log"
-            )
         log.info(
             "==> model %s | %d devices | global batch %d | %d steps/epoch",
             cfg.model,
