@@ -32,6 +32,32 @@ from pytorch_cifar_tpu.models.vgg import VGG11, VGG13, VGG16, VGG19
 from pytorch_cifar_tpu.models.mobilenet import MobileNet
 from pytorch_cifar_tpu.models.mobilenetv2 import MobileNetV2
 from pytorch_cifar_tpu.models.senet import SENet18
+from pytorch_cifar_tpu.models.googlenet import GoogLeNet
+from pytorch_cifar_tpu.models.densenet import (
+    DenseNet121,
+    DenseNet161,
+    DenseNet169,
+    DenseNet201,
+    DenseNetCifar,
+)
+from pytorch_cifar_tpu.models.resnext import (
+    ResNeXt29_2x64d,
+    ResNeXt29_4x64d,
+    ResNeXt29_8x64d,
+    ResNeXt29_32x4d,
+)
+from pytorch_cifar_tpu.models.regnet import (
+    RegNetX_200MF,
+    RegNetX_400MF,
+    RegNetY_400MF,
+)
+from pytorch_cifar_tpu.models.dpn import DPN26, DPN92
+from pytorch_cifar_tpu.models.shufflenet import ShuffleNetG2, ShuffleNetG3
+from pytorch_cifar_tpu.models.shufflenetv2 import ShuffleNetV2
+from pytorch_cifar_tpu.models.efficientnet import EfficientNetB0
+from pytorch_cifar_tpu.models.pnasnet import PNASNetA, PNASNetB
+from pytorch_cifar_tpu.models.dla_simple import SimpleDLA
+from pytorch_cifar_tpu.models.dla import DLA
 
 MODEL_REGISTRY: Dict[str, Callable[..., nn.Module]] = {}
 
@@ -72,3 +98,49 @@ register("VGG19", VGG19)
 register("MobileNet", MobileNet)
 register("MobileNetV2", MobileNetV2)
 register("SENet18", SENet18)
+register("GoogLeNet", GoogLeNet)
+register("DenseNet121", DenseNet121)
+register("DenseNet169", DenseNet169)
+register("DenseNet201", DenseNet201)
+register("DenseNet161", DenseNet161)
+register("DenseNetCifar", DenseNetCifar)
+register("ResNeXt29_2x64d", ResNeXt29_2x64d)
+register("ResNeXt29_4x64d", ResNeXt29_4x64d)
+register("ResNeXt29_8x64d", ResNeXt29_8x64d)
+register("ResNeXt29_32x4d", ResNeXt29_32x4d)
+register("RegNetX_200MF", RegNetX_200MF)
+register("RegNetX_400MF", RegNetX_400MF)
+register("RegNetY_400MF", RegNetY_400MF)
+register("DPN26", DPN26)
+register("DPN92", DPN92)
+register("ShuffleNetG2", ShuffleNetG2)
+register("ShuffleNetG3", ShuffleNetG3)
+register(
+    "ShuffleNetV2_0.5",
+    lambda num_classes=10, dtype=None, **kw: ShuffleNetV2(
+        0.5, num_classes=num_classes, dtype=dtype, **kw
+    ),
+)
+register(
+    "ShuffleNetV2_1",
+    lambda num_classes=10, dtype=None, **kw: ShuffleNetV2(
+        1, num_classes=num_classes, dtype=dtype, **kw
+    ),
+)
+register(
+    "ShuffleNetV2_1.5",
+    lambda num_classes=10, dtype=None, **kw: ShuffleNetV2(
+        1.5, num_classes=num_classes, dtype=dtype, **kw
+    ),
+)
+register(
+    "ShuffleNetV2_2",
+    lambda num_classes=10, dtype=None, **kw: ShuffleNetV2(
+        2, num_classes=num_classes, dtype=dtype, **kw
+    ),
+)
+register("EfficientNetB0", EfficientNetB0)
+register("PNASNetA", PNASNetA)
+register("PNASNetB", PNASNetB)
+register("SimpleDLA", SimpleDLA)
+register("DLA", DLA)

@@ -179,5 +179,17 @@ def global_avg_pool(x):
     return jnp.mean(x, axis=(1, 2))
 
 
+def channel_shuffle(x, groups: int):
+    """ShuffleNet channel shuffle, NHWC: C -> (g, C/g) -> transpose -> C.
+
+    Matches the reference's view/permute/reshape on the channel axis
+    (models/shufflenet.py:15-19, models/shufflenetv2.py:15-19).
+    """
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, -1, -2)
+    return x.reshape(n, h, w, c)
+
+
 def count_params(params) -> int:
     return sum(p.size for p in jax.tree_util.tree_leaves(params))

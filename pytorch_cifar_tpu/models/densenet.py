@@ -1,0 +1,98 @@
+"""DenseNet for CIFAR-10 (reference: models/densenet.py:9-99).
+
+Pre-activation bottleneck layers (BN-ReLU-conv1x1(4g) -> BN-ReLU-conv3x3(g))
+whose output is concatenated *in front of* the running feature stack
+(torch.cat([out, x]), models/densenet.py:20 — order preserved here so BN
+channel statistics line up). Transitions halve channels (floor(planes*0.5),
+models/densenet.py:46) and avg-pool 2x. Stem conv3x3 to 2*growth; head
+BN-ReLU-avgpool4-linear (models/densenet.py:81-83). All convs bias-free.
+
+Golden param counts: DenseNet121 6,956,298 · DenseNet169 12,493,322 ·
+DenseNet201 18,104,330 · DenseNet161 26,482,378 · densenet_cifar 1,000,618.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+)
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        out = nn.relu(bn()(x))
+        out = Conv(4 * self.growth_rate, 1, use_bias=False, dtype=self.dtype)(out)
+        out = nn.relu(bn()(out))
+        out = Conv(self.growth_rate, 3, padding=1, use_bias=False, dtype=self.dtype)(out)
+        return jnp.concatenate([out, x], axis=-1)
+
+
+class Transition(nn.Module):
+    out_planes: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        x = Conv(self.out_planes, 1, use_bias=False, dtype=self.dtype)(x)
+        return avg_pool(x, 2)
+
+
+class DenseNet(nn.Module):
+    nblocks: Sequence[int]
+    growth_rate: int = 12
+    reduction: float = 0.5
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        g = self.growth_rate
+        planes = 2 * g
+        x = Conv(planes, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        for stage, nblock in enumerate(self.nblocks):
+            for _ in range(nblock):
+                x = DenseLayer(g, dtype=self.dtype)(x, train)
+            planes += nblock * g
+            if stage < len(self.nblocks) - 1:
+                planes = int(math.floor(planes * self.reduction))
+                x = Transition(planes, dtype=self.dtype)(x, train)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def DenseNet121(num_classes: int = 10, dtype=None, **kw):
+    return DenseNet((6, 12, 24, 16), 32, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def DenseNet169(num_classes: int = 10, dtype=None, **kw):
+    return DenseNet((6, 12, 32, 32), 32, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def DenseNet201(num_classes: int = 10, dtype=None, **kw):
+    return DenseNet((6, 12, 48, 32), 32, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def DenseNet161(num_classes: int = 10, dtype=None, **kw):
+    return DenseNet((6, 12, 36, 24), 48, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def DenseNetCifar(num_classes: int = 10, dtype=None, **kw):
+    return DenseNet((6, 12, 24, 16), 12, num_classes=num_classes, dtype=dtype, **kw)

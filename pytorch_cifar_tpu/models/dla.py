@@ -1,0 +1,70 @@
+"""DLA (paper version) for CIFAR-10 (reference: models/dla.py:11-123).
+
+Differs from SimpleDLA in the Tree: a level-N tree aggregates
+(level+2)*out_channels at its root — a ``prev_root`` block on the raw input,
+the chain of level-i subtrees, and the left/right nodes
+(models/dla.py:62-82). Level-1 trees match SimpleDLA's binary form. Stage
+layout and stems are identical to SimpleDLA (models/dla.py:88-110).
+
+Golden param count: 16,291,386.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import BatchNorm, Conv, Dense, avg_pool
+from pytorch_cifar_tpu.models.dla_simple import BasicBlock, Root
+
+
+class Tree(nn.Module):
+    """Paper aggregation tree (models/dla.py:53-82); levels <= 2 in this net,
+    so the recursion unrolls statically at trace time."""
+
+    out_channels: int
+    level: int = 1
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        if self.level == 1:
+            out1 = BasicBlock(self.out_channels, self.stride, dtype=self.dtype)(
+                x, train
+            )
+            out2 = BasicBlock(self.out_channels, 1, dtype=self.dtype)(out1, train)
+            return Root(self.out_channels, dtype=self.dtype)([out1, out2], train)
+
+        xs = [
+            BasicBlock(self.out_channels, self.stride, dtype=self.dtype)(x, train)
+        ]  # prev_root
+        for i in reversed(range(1, self.level)):
+            x = Tree(self.out_channels, i, self.stride, dtype=self.dtype)(x, train)
+            xs.append(x)
+        x = BasicBlock(self.out_channels, 1, dtype=self.dtype)(x, train)
+        xs.append(x)
+        x = BasicBlock(self.out_channels, 1, dtype=self.dtype)(x, train)
+        xs.append(x)
+        return Root(self.out_channels, dtype=self.dtype)(xs, train)
+
+
+class DLA(nn.Module):
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for width in (16, 16, 32):  # base, layer1, layer2
+            x = Conv(width, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+            x = nn.relu(
+                BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+            )
+        for out_ch, level, stride in (
+            (64, 1, 1), (128, 2, 2), (256, 2, 2), (512, 1, 2)
+        ):
+            x = Tree(out_ch, level, stride, dtype=self.dtype)(x, train)
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,109 @@
+"""SimpleDLA for CIFAR-10 (reference: models/dla_simple.py:16-116) — the
+default model of the reference's single-node trainer (main.py:71).
+
+Deep-layer aggregation with a binary Tree: left subtree at the stage stride,
+right subtree fed from the left's output, aggregated by a Root
+(concat + 1x1 conv + BN + ReLU, models/dla_simple.py:44-55,71-75). Blocks
+are ResNet BasicBlocks. Stages: three conv3x3+BN+ReLU stems (16,16,32), then
+Trees 64/l1/s1, 128/l2/s2, 256/l2/s2, 512/l1/s2, avg-pool 4 + linear
+(models/dla_simple.py:81-103).
+
+Golden param count: 15,142,970.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+)
+
+
+class BasicBlock(nn.Module):
+    """conv3x3-BN-ReLU-conv3x3-BN + projection shortcut (dla_simple.py:16-41)."""
+
+    planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        out = Conv(self.planes, 3, strides=self.stride, padding=1,
+                   use_bias=False, dtype=self.dtype)(x)
+        out = nn.relu(bn()(out))
+        out = Conv(self.planes, 3, padding=1, use_bias=False, dtype=self.dtype)(out)
+        out = bn()(out)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            x = Conv(self.planes, 1, strides=self.stride, use_bias=False,
+                     dtype=self.dtype)(x)
+            x = bn()(x)
+        return nn.relu(out + x)
+
+
+class Root(nn.Module):
+    """concat -> 1x1 conv -> BN -> ReLU (dla_simple.py:44-55)."""
+
+    out_channels: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, xs, train: bool):
+        x = jnp.concatenate(xs, axis=-1)
+        x = Conv(self.out_channels, 1, use_bias=False, dtype=self.dtype)(x)
+        x = BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class Tree(nn.Module):
+    """Binary aggregation tree (dla_simple.py:58-75), statically unrolled —
+    levels are <= 2 so recursion depth is fixed at trace time."""
+
+    out_channels: int
+    level: int = 1
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        if self.level == 1:
+            out1 = BasicBlock(self.out_channels, self.stride, dtype=self.dtype)(
+                x, train
+            )
+            out2 = BasicBlock(self.out_channels, 1, dtype=self.dtype)(out1, train)
+        else:
+            out1 = Tree(
+                self.out_channels, self.level - 1, self.stride, dtype=self.dtype
+            )(x, train)
+            out2 = Tree(self.out_channels, self.level - 1, 1, dtype=self.dtype)(
+                out1, train
+            )
+        return Root(self.out_channels, dtype=self.dtype)([out1, out2], train)
+
+
+class SimpleDLA(nn.Module):
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for width in (16, 16, 32):  # base, layer1, layer2
+            x = Conv(width, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+            x = nn.relu(
+                BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+            )
+        for out_ch, level, stride in (
+            (64, 1, 1), (128, 2, 2), (256, 2, 2), (512, 1, 2)
+        ):
+            x = Tree(out_ch, level, stride, dtype=self.dtype)(x, train)
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)

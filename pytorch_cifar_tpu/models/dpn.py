@@ -1,0 +1,102 @@
+"""Dual Path Networks for CIFAR-10 (reference: models/dpn.py:7-89).
+
+Each bottleneck emits out_planes+dense_depth channels; the first out_planes
+are a residual path (added to the shortcut's first out_planes) and the tail
+is a dense path concatenated onto both stacks
+(torch.cat([x[:d]+out[:d], x[d:], out[d:]]), models/dpn.py:32-34). The
+projection shortcut exists only on each stage's first block
+(models/dpn.py:20-25); grouped 3x3 uses groups=32 everywhere
+(models/dpn.py:15). Stem conv3x3(3->64)+BN+ReLU; head avg-pool 4 + linear
+from out_planes[3]+(num_blocks[3]+1)*dense_depth[3] (models/dpn.py:44-51,67).
+
+Golden param counts: DPN26 11,574,842 · DPN92 34,236,634.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+)
+
+
+class DualPathBlock(nn.Module):
+    in_planes: int
+    out_planes: int
+    dense_depth: int
+    stride: int
+    first_layer: bool
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        out = Conv(self.in_planes, 1, use_bias=False, dtype=self.dtype)(x)
+        out = nn.relu(bn()(out))
+        out = Conv(self.in_planes, 3, strides=self.stride, padding=1,
+                   groups=32, use_bias=False, dtype=self.dtype)(out)
+        out = nn.relu(bn()(out))
+        out = Conv(self.out_planes + self.dense_depth, 1, use_bias=False,
+                   dtype=self.dtype)(out)
+        out = bn()(out)
+
+        if self.first_layer:
+            x = Conv(self.out_planes + self.dense_depth, 1,
+                     strides=self.stride, use_bias=False, dtype=self.dtype)(x)
+            x = bn()(x)
+        d = self.out_planes
+        out = jnp.concatenate(
+            [x[..., :d] + out[..., :d], x[..., d:], out[..., d:]], axis=-1
+        )
+        return nn.relu(out)
+
+
+class DPN(nn.Module):
+    cfg: Mapping[str, Any]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        x = Conv(64, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        for stage in range(4):
+            stride = 1 if stage == 0 else 2
+            for i in range(cfg["num_blocks"][stage]):
+                x = DualPathBlock(
+                    cfg["in_planes"][stage],
+                    cfg["out_planes"][stage],
+                    cfg["dense_depth"][stage],
+                    stride if i == 0 else 1,
+                    first_layer=i == 0,
+                    dtype=self.dtype,
+                )(x, train)
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+_CFG_BASE = {
+    "in_planes": (96, 192, 384, 768),
+    "out_planes": (256, 512, 1024, 2048),
+    "dense_depth": (16, 32, 24, 128),
+}
+
+
+def DPN26(num_classes: int = 10, dtype=None, **kw):
+    cfg = dict(_CFG_BASE, num_blocks=(2, 2, 2, 2))
+    return DPN(cfg, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def DPN92(num_classes: int = 10, dtype=None, **kw):
+    cfg = dict(_CFG_BASE, num_blocks=(3, 4, 20, 3))
+    return DPN(cfg, num_classes=num_classes, dtype=dtype, **kw)

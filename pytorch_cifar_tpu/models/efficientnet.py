@@ -1,0 +1,156 @@
+"""EfficientNet-B0 for CIFAR-10 (reference: models/efficientnet.py:12-164).
+
+MBConv blocks: 1x1 expand (skipped when expand_ratio==1,
+models/efficientnet.py:96) -> depthwise 3x3/5x5 -> SE (width = block *input*
+channels * 0.25, models/efficientnet.py:80) -> 1x1 project, swish
+activations. Skip connection when stride==1 and channels match, with
+per-block stochastic depth whose rate scales linearly with block index
+(drop_connect_rate * b / blocks, models/efficientnet.py:130). Head: global
+avg-pool + dropout(0.2) + linear (models/efficientnet.py:145-150).
+
+The reference's in-place ``drop_connect`` (models/efficientnet.py:16-22,
+SURVEY.md §2.5.15) becomes a pure function drawing from the ``stochastic``
+PRNG collection — plumbed by the train step (train/steps.py); eval and
+init need no key. Golden param count: 3,599,686.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    global_avg_pool,
+)
+
+
+def swish(x):
+    return x * nn.sigmoid(x)
+
+
+def drop_connect(rng, x, drop_rate: float):
+    """Per-sample stochastic depth: keep with p=1-drop_rate, rescale kept."""
+    keep = 1.0 - drop_rate
+    mask = jax.random.bernoulli(rng, keep, shape=(x.shape[0], 1, 1, 1))
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class SE(nn.Module):
+    """Squeeze-excitation with swish on the reduce conv."""
+
+    se_channels: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        w = jnp.mean(x, axis=(1, 2), keepdims=True)
+        w = swish(Conv(self.se_channels, 1, dtype=self.dtype)(w))
+        w = nn.sigmoid(Conv(x.shape[-1], 1, dtype=self.dtype)(w))
+        return x * w
+
+
+class MBConv(nn.Module):
+    out_channels: int
+    kernel_size: int
+    stride: int
+    expand_ratio: int
+    se_ratio: float
+    drop_rate: float
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        in_ch = x.shape[-1]
+        channels = self.expand_ratio * in_ch
+
+        # The reference *constructs* conv1/bn1 even when expand_ratio==1 but
+        # skips them in forward (models/efficientnet.py:60-67 vs :96) — 1,088
+        # dead params in block 0. Mirror that so golden counts match.
+        if self.expand_ratio != 1:
+            out = swish(bn()(Conv(channels, 1, use_bias=False, dtype=self.dtype)(x)))
+        else:
+            # pinned to running-average mode: no batch_stats mutation, and the
+            # unused output is dead-code-eliminated by XLA
+            dead = BatchNorm(use_running_average=True, dtype=self.dtype)
+            _ = dead(Conv(channels, 1, use_bias=False, dtype=self.dtype)(x))
+            out = x
+        out = Conv(
+            channels,
+            self.kernel_size,
+            strides=self.stride,
+            padding=1 if self.kernel_size == 3 else 2,
+            groups=channels,
+            use_bias=False,
+            dtype=self.dtype,
+        )(out)
+        out = swish(bn()(out))
+        out = SE(int(in_ch * self.se_ratio), dtype=self.dtype)(out)
+        out = Conv(self.out_channels, 1, use_bias=False, dtype=self.dtype)(out)
+        out = bn()(out)
+
+        if self.stride == 1 and in_ch == self.out_channels:
+            if train and self.drop_rate > 0:
+                out = drop_connect(
+                    self.make_rng("stochastic"), out, self.drop_rate
+                )
+            out = out + x
+        return out
+
+
+class EfficientNet(nn.Module):
+    cfg: Mapping[str, Any]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        x = Conv(32, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = swish(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+
+        b, blocks = 0, sum(cfg["num_blocks"])
+        for expansion, out_ch, nblocks, ks, stride in zip(
+            cfg["expansion"],
+            cfg["out_channels"],
+            cfg["num_blocks"],
+            cfg["kernel_size"],
+            cfg["stride"],
+        ):
+            for i in range(nblocks):
+                x = MBConv(
+                    out_ch,
+                    ks,
+                    stride if i == 0 else 1,
+                    expansion,
+                    se_ratio=0.25,
+                    drop_rate=cfg["drop_connect_rate"] * b / blocks,
+                    dtype=self.dtype,
+                )(x, train)
+                b += 1
+
+        x = global_avg_pool(x)
+        if train and cfg["dropout_rate"] > 0:
+            x = nn.Dropout(rate=cfg["dropout_rate"], deterministic=False,
+                           rng_collection="stochastic")(x)
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def EfficientNetB0(num_classes: int = 10, dtype=None, **kw):
+    cfg = {
+        "num_blocks": (1, 2, 2, 3, 3, 4, 1),
+        "expansion": (1, 6, 6, 6, 6, 6, 6),
+        "out_channels": (16, 24, 40, 80, 112, 192, 320),
+        "kernel_size": (3, 3, 5, 3, 5, 5, 3),
+        "stride": (1, 2, 2, 2, 1, 2, 1),
+        "dropout_rate": 0.2,
+        "drop_connect_rate": 0.2,
+    }
+    return EfficientNet(cfg, num_classes=num_classes, dtype=dtype, **kw)

@@ -1,0 +1,95 @@
+"""GoogLeNet for CIFAR-10 (reference: models/googlenet.py:7-98).
+
+Inception block with four parallel branches concatenated on channels
+(models/googlenet.py:48-53): 1x1 / 1x1->3x3 / 1x1->3x3->3x3 (the 5x5 branch
+implemented as two 3x3s, models/googlenet.py:28-38) / maxpool3->1x1. All
+branch convs keep their bias (torch default). Stem is conv3x3(3->192)+BN+ReLU
+(models/googlenet.py:59-63); stage transitions are maxpool 3/s2/p1
+(models/googlenet.py:68); head is 8x8 avg-pool + 1024->10 linear
+(models/googlenet.py:79-80).
+
+Golden param count: 6,166,250.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+    max_pool,
+)
+
+
+class Inception(nn.Module):
+    """Four-branch inception cell; output channels = sum of branch widths."""
+
+    n1x1: int
+    n3x3red: int
+    n3x3: int
+    n5x5red: int
+    n5x5: int
+    pool_planes: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        def cbr(h, features, kernel, padding=0):
+            h = Conv(features, kernel, padding=padding, dtype=self.dtype)(h)
+            h = BatchNorm(use_running_average=not train, dtype=self.dtype)(h)
+            return nn.relu(h)
+
+        y1 = cbr(x, self.n1x1, 1)
+
+        y2 = cbr(x, self.n3x3red, 1)
+        y2 = cbr(y2, self.n3x3, 3, padding=1)
+
+        y3 = cbr(x, self.n5x5red, 1)
+        y3 = cbr(y3, self.n5x5, 3, padding=1)
+        y3 = cbr(y3, self.n5x5, 3, padding=1)
+
+        y4 = max_pool(x, 3, stride=1, padding=1)
+        y4 = cbr(y4, self.pool_planes, 1)
+
+        return jnp.concatenate([y1, y2, y3, y4], axis=-1)
+
+
+# (n1x1, n3x3red, n3x3, n5x5red, n5x5, pool_planes) per cell, in call order;
+# None marks a maxpool 3/s2/p1 transition (models/googlenet.py:65-77,82-94)
+_CELLS: Tuple = (
+    (64, 96, 128, 16, 32, 32),     # a3
+    (128, 128, 192, 32, 96, 64),   # b3
+    None,
+    (192, 96, 208, 16, 48, 64),    # a4
+    (160, 112, 224, 24, 64, 64),   # b4
+    (128, 128, 256, 24, 64, 64),   # c4
+    (112, 144, 288, 32, 64, 64),   # d4
+    (256, 160, 320, 32, 128, 128), # e4
+    None,
+    (256, 160, 320, 32, 128, 128), # a5
+    (384, 192, 384, 48, 128, 128), # b5
+)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(192, 3, padding=1, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        for cell in _CELLS:
+            if cell is None:
+                x = max_pool(x, 3, stride=2, padding=1)
+            else:
+                x = Inception(*cell, dtype=self.dtype)(x, train)
+        x = avg_pool(x, 8, stride=1)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,119 @@
+"""PNASNet A/B for CIFAR-10 (reference: models/pnasnet.py:10-116).
+
+Separable conv = depthwise conv with channel multiplier (groups=in_planes,
+out_planes a multiple of in_planes) + BN, no pointwise stage and no
+activation (models/pnasnet.py:10-21 — an intentional simplification of the
+paper kept for parity). CellA: sep7x7 + maxpool3 branches, added
+(models/pnasnet.py:33-38). CellB: (sep7x7+sep3x3) and (maxpool+sep5x5)
+branch pairs, relu'd, concatenated, then 1x1-reduced
+(models/pnasnet.py:56-69). Stride-2 cells add a 1x1+BN after the maxpool.
+Layout: 6 cells / downsample x2 / 6 / downsample x4 / 6, then avg-pool 8 +
+linear (models/pnasnet.py:80-86,100-108).
+
+Golden param counts: PNASNetA 130,646 · PNASNetB 451,626.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Type
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+    max_pool,
+)
+
+
+class SepConv(nn.Module):
+    """Depthwise conv (channel multiplier out/in) + BN."""
+
+    out_planes: int
+    kernel_size: int
+    stride: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = Conv(
+            self.out_planes,
+            self.kernel_size,
+            strides=self.stride,
+            padding=(self.kernel_size - 1) // 2,
+            groups=x.shape[-1],
+            use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        return BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+
+
+class CellA(nn.Module):
+    out_planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        y1 = SepConv(self.out_planes, 7, self.stride, dtype=self.dtype)(x, train)
+        y2 = max_pool(x, 3, stride=self.stride, padding=1)
+        if self.stride == 2:
+            y2 = Conv(self.out_planes, 1, use_bias=False, dtype=self.dtype)(y2)
+            y2 = BatchNorm(use_running_average=not train, dtype=self.dtype)(y2)
+        return nn.relu(y1 + y2)
+
+
+class CellB(nn.Module):
+    out_planes: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        y1 = SepConv(self.out_planes, 7, self.stride, dtype=self.dtype)(x, train)
+        y2 = SepConv(self.out_planes, 3, self.stride, dtype=self.dtype)(x, train)
+        y3 = max_pool(x, 3, stride=self.stride, padding=1)
+        if self.stride == 2:
+            y3 = Conv(self.out_planes, 1, use_bias=False, dtype=self.dtype)(y3)
+            y3 = BatchNorm(use_running_average=not train, dtype=self.dtype)(y3)
+        y4 = SepConv(self.out_planes, 5, self.stride, dtype=self.dtype)(x, train)
+        y = jnp.concatenate([nn.relu(y1 + y2), nn.relu(y3 + y4)], axis=-1)
+        y = Conv(self.out_planes, 1, use_bias=False, dtype=self.dtype)(y)
+        return nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(y))
+
+
+class PNASNet(nn.Module):
+    cell_type: Type[nn.Module]
+    num_planes: int
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        p = self.num_planes
+        x = Conv(p, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        for planes, downsample in (
+            (p, False), (2 * p, True), (2 * p, False),
+            (4 * p, True), (4 * p, False),
+        ):
+            if downsample:
+                x = self.cell_type(planes, stride=2, dtype=self.dtype)(x, train)
+            else:
+                for _ in range(6):
+                    x = self.cell_type(planes, stride=1, dtype=self.dtype)(x, train)
+        x = avg_pool(x, 8)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def PNASNetA(num_classes: int = 10, dtype=None, **kw):
+    return PNASNet(CellA, 44, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def PNASNetB(num_classes: int = 10, dtype=None, **kw):
+    return PNASNet(CellB, 32, num_classes=num_classes, dtype=dtype, **kw)

@@ -1,0 +1,138 @@
+"""RegNet X/Y for CIFAR-10 (reference: models/regnet.py:12-143).
+
+Residual bottleneck (ratio 1) with grouped 3x3 (groups = width/group_width,
+models/regnet.py:36-38), optional SE between the grouped conv and projection
+(Y variants, se width = round(w_in * 0.25), models/regnet.py:41-44 — note SE
+width derives from the block *input* width, not the bottleneck width).
+Projection shortcut on stride/width change (models/regnet.py:49-55). Stem
+conv3x3(3->64); head adaptive avg-pool + linear (models/regnet.py:73-80,104).
+
+Golden param counts: X_200MF 2,321,946 · X_400MF 4,779,338 · Y_400MF 5,714,362.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    global_avg_pool,
+)
+
+
+class SE(nn.Module):
+    """Squeeze-excitation: global pool -> 1x1 reduce -> 1x1 expand -> sigmoid gate."""
+
+    se_planes: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        w = jnp.mean(x, axis=(1, 2), keepdims=True)
+        w = nn.relu(Conv(self.se_planes, 1, dtype=self.dtype)(w))
+        w = nn.sigmoid(Conv(x.shape[-1], 1, dtype=self.dtype)(w))
+        return x * w
+
+
+class RegNetBlock(nn.Module):
+    w_out: int
+    stride: int
+    group_width: int
+    bottleneck_ratio: float
+    se_planes: int  # 0 disables SE
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        w_b = int(round(self.w_out * self.bottleneck_ratio))
+        groups = w_b // self.group_width
+
+        out = Conv(w_b, 1, use_bias=False, dtype=self.dtype)(x)
+        out = nn.relu(bn()(out))
+        out = Conv(w_b, 3, strides=self.stride, padding=1, groups=groups,
+                   use_bias=False, dtype=self.dtype)(out)
+        out = nn.relu(bn()(out))
+        if self.se_planes > 0:
+            out = SE(self.se_planes, dtype=self.dtype)(out)
+        out = Conv(self.w_out, 1, use_bias=False, dtype=self.dtype)(out)
+        out = bn()(out)
+
+        if self.stride != 1 or x.shape[-1] != self.w_out:
+            x = Conv(self.w_out, 1, strides=self.stride, use_bias=False,
+                     dtype=self.dtype)(x)
+            x = bn()(x)
+        return nn.relu(out + x)
+
+
+class RegNet(nn.Module):
+    cfg: Mapping[str, Any]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        x = Conv(64, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        for depth, width, stride in zip(
+            cfg["depths"], cfg["widths"], cfg["strides"]
+        ):
+            for i in range(depth):
+                se_planes = (
+                    int(round(x.shape[-1] * cfg["se_ratio"]))
+                    if cfg["se_ratio"] > 0
+                    else 0
+                )
+                x = RegNetBlock(
+                    width,
+                    stride if i == 0 else 1,
+                    cfg["group_width"],
+                    cfg["bottleneck_ratio"],
+                    se_planes,
+                    dtype=self.dtype,
+                )(x, train)
+        x = global_avg_pool(x)
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def RegNetX_200MF(num_classes: int = 10, dtype=None, **kw):
+    cfg = {
+        "depths": (1, 1, 4, 7),
+        "widths": (24, 56, 152, 368),
+        "strides": (1, 1, 2, 2),
+        "group_width": 8,
+        "bottleneck_ratio": 1,
+        "se_ratio": 0,
+    }
+    return RegNet(cfg, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def RegNetX_400MF(num_classes: int = 10, dtype=None, **kw):
+    cfg = {
+        "depths": (1, 2, 7, 12),
+        "widths": (32, 64, 160, 384),
+        "strides": (1, 1, 2, 2),
+        "group_width": 16,
+        "bottleneck_ratio": 1,
+        "se_ratio": 0,
+    }
+    return RegNet(cfg, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def RegNetY_400MF(num_classes: int = 10, dtype=None, **kw):
+    cfg = {
+        "depths": (1, 2, 7, 12),
+        "widths": (32, 64, 160, 384),
+        "strides": (1, 1, 2, 2),
+        "group_width": 16,
+        "bottleneck_ratio": 1,
+        "se_ratio": 0.25,
+    }
+    return RegNet(cfg, num_classes=num_classes, dtype=dtype, **kw)

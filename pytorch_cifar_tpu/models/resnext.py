@@ -1,0 +1,94 @@
+"""ResNeXt-29 for CIFAR-10 (reference: models/resnext.py:10-87).
+
+Grouped-conv bottleneck (1x1 -> grouped 3x3 -> 1x1 expand x2) with projection
+shortcut on stride/width change (models/resnext.py:24-29). Three stages only
+(layer4 commented out in the reference, models/resnext.py:52) with strides
+1,2,2; bottleneck width doubles per stage (models/resnext.py:62). Stem is a
+1x1 conv (models/resnext.py:47). Head: 8x8 avg-pool + linear from
+cardinality*width*8 (models/resnext.py:53).
+
+Golden param counts: 2x64d 9,128,778 · 4x64d 27,104,586 · 8x64d 89,598,282 ·
+32x4d 4,774,218.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+)
+
+_EXPANSION = 2
+
+
+class ResNeXtBlock(nn.Module):
+    cardinality: int
+    bottleneck_width: int
+    stride: int = 1
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        group_width = self.cardinality * self.bottleneck_width
+        out_width = _EXPANSION * group_width
+
+        out = Conv(group_width, 1, use_bias=False, dtype=self.dtype)(x)
+        out = nn.relu(bn()(out))
+        out = Conv(group_width, 3, strides=self.stride, padding=1,
+                   groups=self.cardinality, use_bias=False, dtype=self.dtype)(out)
+        out = nn.relu(bn()(out))
+        out = Conv(out_width, 1, use_bias=False, dtype=self.dtype)(out)
+        out = bn()(out)
+
+        if self.stride != 1 or x.shape[-1] != out_width:
+            x = Conv(out_width, 1, strides=self.stride, use_bias=False,
+                     dtype=self.dtype)(x)
+            x = bn()(x)
+        return nn.relu(out + x)
+
+
+class ResNeXt(nn.Module):
+    num_blocks: Sequence[int]
+    cardinality: int
+    bottleneck_width: int
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(64, 1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        width = self.bottleneck_width
+        for stage, nblocks in enumerate(self.num_blocks):
+            for i in range(nblocks):
+                stride = (1 if stage == 0 else 2) if i == 0 else 1
+                x = ResNeXtBlock(self.cardinality, width, stride,
+                                 dtype=self.dtype)(x, train)
+            width *= 2
+        x = avg_pool(x, 8)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def ResNeXt29_2x64d(num_classes: int = 10, dtype=None, **kw):
+    return ResNeXt((3, 3, 3), 2, 64, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def ResNeXt29_4x64d(num_classes: int = 10, dtype=None, **kw):
+    return ResNeXt((3, 3, 3), 4, 64, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def ResNeXt29_8x64d(num_classes: int = 10, dtype=None, **kw):
+    return ResNeXt((3, 3, 3), 8, 64, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def ResNeXt29_32x4d(num_classes: int = 10, dtype=None, **kw):
+    return ResNeXt((3, 3, 3), 32, 4, num_classes=num_classes, dtype=dtype, **kw)

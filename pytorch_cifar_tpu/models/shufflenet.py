@@ -1,0 +1,98 @@
+"""ShuffleNet V1 for CIFAR-10 (reference: models/shufflenet.py:10-100).
+
+Grouped 1x1 -> channel shuffle -> depthwise 3x3 -> grouped 1x1 bottleneck
+(models/shufflenet.py:41-48). Stride-2 blocks concat an avg-pool(3/s2/p1)
+shortcut; stride-1 blocks add (models/shufflenet.py:37-39,47). Each stage's
+first block therefore emits out_planes - in_planes channels
+(models/shufflenet.py:70-71). The first bottleneck's 1x1s use groups=1
+because the 24-channel stem width is not group-divisible
+(models/shufflenet.py:28). Stem conv1x1(3->24); head avg-pool 4 + linear.
+
+The reference is broken under Python 3 — ``mid_planes = out_planes/4`` is a
+float (models/shufflenet.py:27, SURVEY.md §2.5.1); fixed here with integer
+division. Golden param counts (measured with that fix): G2 887,582 ·
+G3 862,768.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Mapping, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+    channel_shuffle,
+)
+
+
+class ShuffleBottleneck(nn.Module):
+    out_planes: int
+    stride: int
+    groups: int
+    first_groups: int  # groups for the 1x1s; 1 on the stem-fed block
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        mid = self.out_planes // 4  # int division: the reference's Py3 fix
+        g = self.first_groups
+
+        out = Conv(mid, 1, groups=g, use_bias=False, dtype=self.dtype)(x)
+        out = nn.relu(bn()(out))
+        out = channel_shuffle(out, g)
+        out = Conv(mid, 3, strides=self.stride, padding=1, groups=mid,
+                   use_bias=False, dtype=self.dtype)(out)
+        out = nn.relu(bn()(out))
+        out = Conv(self.out_planes, 1, groups=self.groups, use_bias=False,
+                   dtype=self.dtype)(out)
+        out = bn()(out)
+
+        if self.stride == 2:
+            res = avg_pool(x, 3, stride=2, padding=1)
+            return nn.relu(jnp.concatenate([out, res], axis=-1))
+        return nn.relu(out + x)
+
+
+class ShuffleNet(nn.Module):
+    cfg: Mapping[str, Any]
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        groups = cfg["groups"]
+        x = Conv(24, 1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        in_planes = 24
+        for out_planes, num_blocks in zip(cfg["out_planes"], cfg["num_blocks"]):
+            for i in range(num_blocks):
+                cat_planes = in_planes if i == 0 else 0
+                x = ShuffleBottleneck(
+                    out_planes - cat_planes,
+                    stride=2 if i == 0 else 1,
+                    groups=groups,
+                    first_groups=1 if in_planes == 24 else groups,
+                    dtype=self.dtype,
+                )(x, train)
+                in_planes = out_planes
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def ShuffleNetG2(num_classes: int = 10, dtype=None, **kw):
+    cfg = {"out_planes": (200, 400, 800), "num_blocks": (4, 8, 4), "groups": 2}
+    return ShuffleNet(cfg, num_classes=num_classes, dtype=dtype, **kw)
+
+
+def ShuffleNetG3(num_classes: int = 10, dtype=None, **kw):
+    cfg = {"out_planes": (240, 480, 960), "num_blocks": (4, 8, 4), "groups": 3}
+    return ShuffleNet(cfg, num_classes=num_classes, dtype=dtype, **kw)

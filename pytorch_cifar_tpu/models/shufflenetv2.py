@@ -1,0 +1,112 @@
+"""ShuffleNetV2 for CIFAR-10 (reference: models/shufflenetv2.py:10-152).
+
+Basic block: split channels 50/50 (models/shufflenetv2.py:27-29), transform
+the *second* half (1x1 -> depthwise 3x3 (no relu after) -> 1x1), concat with
+the untouched first half, then channel-shuffle with g=2
+(models/shufflenetv2.py:48-55). Down block: two stride-2 branches (depthwise
+then 1x1 / 1x1 then depthwise then 1x1), concat + shuffle
+(models/shufflenetv2.py:82-93). Stem conv3x3(3->24) with the ImageNet
+maxpool removed (models/shufflenetv2.py:123); final 1x1 expand then avg-pool
+4 + linear (models/shufflenetv2.py:109-112,127-130).
+
+Golden param counts: 0.5x 352,042 · 1x 1,263,854 · 1.5x 2,488,874 ·
+2x 5,338,026.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_cifar_tpu.models.common import (
+    BatchNorm,
+    Conv,
+    Dense,
+    avg_pool,
+    channel_shuffle,
+)
+
+
+class BasicBlock(nn.Module):
+    split_ratio: float = 0.5
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        c = int(x.shape[-1] * self.split_ratio)
+        x1, x2 = x[..., :c], x[..., c:]
+        ch = x2.shape[-1]
+
+        out = Conv(ch, 1, use_bias=False, dtype=self.dtype)(x2)
+        out = nn.relu(bn()(out))
+        out = Conv(ch, 3, padding=1, groups=ch, use_bias=False,
+                   dtype=self.dtype)(out)
+        out = bn()(out)  # no relu after depthwise (models/shufflenetv2.py:51)
+        out = Conv(ch, 1, use_bias=False, dtype=self.dtype)(out)
+        out = nn.relu(bn()(out))
+
+        out = jnp.concatenate([x1, out], axis=-1)
+        return channel_shuffle(out, 2)
+
+
+class DownBlock(nn.Module):
+    out_channels: int
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
+        in_ch = x.shape[-1]
+        mid = self.out_channels // 2
+
+        # left: depthwise s2 -> 1x1
+        left = Conv(in_ch, 3, strides=2, padding=1, groups=in_ch,
+                    use_bias=False, dtype=self.dtype)(x)
+        left = bn()(left)
+        left = Conv(mid, 1, use_bias=False, dtype=self.dtype)(left)
+        left = nn.relu(bn()(left))
+
+        # right: 1x1 -> depthwise s2 -> 1x1
+        right = Conv(mid, 1, use_bias=False, dtype=self.dtype)(x)
+        right = nn.relu(bn()(right))
+        right = Conv(mid, 3, strides=2, padding=1, groups=mid,
+                     use_bias=False, dtype=self.dtype)(right)
+        right = bn()(right)
+        right = Conv(mid, 1, use_bias=False, dtype=self.dtype)(right)
+        right = nn.relu(bn()(right))
+
+        out = jnp.concatenate([left, right], axis=-1)
+        return channel_shuffle(out, 2)
+
+
+_CONFIGS = {
+    0.5: {"out_channels": (48, 96, 192, 1024), "num_blocks": (3, 7, 3)},
+    1: {"out_channels": (116, 232, 464, 1024), "num_blocks": (3, 7, 3)},
+    1.5: {"out_channels": (176, 352, 704, 1024), "num_blocks": (3, 7, 3)},
+    2: {"out_channels": (224, 488, 976, 2048), "num_blocks": (3, 7, 3)},
+}
+
+
+class ShuffleNetV2(nn.Module):
+    net_size: float = 1
+    num_classes: int = 10
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = _CONFIGS[self.net_size]
+        x = Conv(24, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        for out_ch, nblocks in zip(cfg["out_channels"][:3], cfg["num_blocks"]):
+            x = DownBlock(out_ch, dtype=self.dtype)(x, train)
+            for _ in range(nblocks):
+                x = BasicBlock(dtype=self.dtype)(x, train)
+        x = Conv(cfg["out_channels"][3], 1, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        x = avg_pool(x, 4)
+        x = x.reshape((x.shape[0], -1))
+        return Dense(self.num_classes, dtype=self.dtype)(x)

@@ -34,6 +34,34 @@ GOLDEN_PARAMS = {
     "MobileNet": 3_217_226,
     "MobileNetV2": 2_296_922,
     "SENet18": 11_260_354,
+    # measured from the reference under torch 2.13 (ShuffleNetG2/G3 with the
+    # int-division fix for models/shufflenet.py:27 applied in-memory)
+    "GoogLeNet": 6_166_250,
+    "DenseNet121": 6_956_298,
+    "DenseNet169": 12_493_322,
+    "DenseNet201": 18_104_330,
+    "DenseNet161": 26_482_378,
+    "DenseNetCifar": 1_000_618,
+    "ResNeXt29_2x64d": 9_128_778,
+    "ResNeXt29_4x64d": 27_104_586,
+    "ResNeXt29_8x64d": 89_598_282,
+    "ResNeXt29_32x4d": 4_774_218,
+    "RegNetX_200MF": 2_321_946,
+    "RegNetX_400MF": 4_779_338,
+    "RegNetY_400MF": 5_714_362,
+    "DPN26": 11_574_842,
+    "DPN92": 34_236_634,
+    "ShuffleNetG2": 887_582,
+    "ShuffleNetG3": 862_768,
+    "ShuffleNetV2_0.5": 352_042,
+    "ShuffleNetV2_1": 1_263_854,
+    "ShuffleNetV2_1.5": 2_488_874,
+    "ShuffleNetV2_2": 5_338_026,
+    "EfficientNetB0": 3_599_686,
+    "PNASNetA": 130_646,
+    "PNASNetB": 451_626,
+    "SimpleDLA": 15_142_970,
+    "DLA": 16_291_386,
 }
 
 # Full init+forward of the deepest variants takes minutes on the CPU test
@@ -49,6 +77,18 @@ SHAPE_CHECKED = {
     "MobileNet",
     "MobileNetV2",
     "SENet18",
+    # one per new family: cheapest variant that exercises every block type
+    "GoogLeNet",
+    "DenseNetCifar",
+    "ResNeXt29_32x4d",
+    "RegNetY_400MF",
+    "DPN26",
+    "ShuffleNetG2",
+    "ShuffleNetV2_0.5",
+    "EfficientNetB0",
+    "PNASNetB",
+    "SimpleDLA",
+    "DLA",
 }
 
 
@@ -93,6 +133,33 @@ def test_batch_stats_update_in_train_mode(name):
     assert any(
         not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(old, new)
     )
+
+
+def test_efficientnet_stochastic_depth_train_step():
+    """EfficientNet's drop_connect + head dropout draw from the 'stochastic'
+    PRNG collection the train step plumbs (reference in-place drop_connect,
+    models/efficientnet.py:16-22, made pure — SURVEY.md §2.5.15)."""
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+    from pytorch_cifar_tpu.train.steps import make_train_step
+
+    model = create_model("EfficientNetB0")
+    tx = make_optimizer(lr=0.01, t_max=10, steps_per_epoch=2)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    step = jax.jit(make_train_step(crop=False), donate_argnums=0)
+    imgs = np.random.RandomState(0).randint(
+        0, 256, (8, 32, 32, 3), dtype=np.uint8
+    )
+    labs = (np.arange(8) % 10).astype(np.int32)
+    state, metrics = step(state, (imgs, labs), jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss_sum"]))
+    # eval path needs no stochastic rng
+    out = model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.zeros((2, 32, 32, 3)),
+        train=False,
+    )
+    assert out.shape == (2, 10)
 
 
 def test_registry_contains_all_models():

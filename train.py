@@ -14,8 +14,7 @@ from pytorch_cifar_tpu.train.trainer import Trainer
 
 def main(argv=None) -> float:
     config = parse_config(argv)
-    # logger setup is owned by Trainer.fit(), gated to the primary process
-    trainer = Trainer(config)
+    trainer = Trainer(config)  # installs the logger (primary process only)
     best = trainer.fit()
     print(f"best test accuracy: {best:.2f}%")
     return best
