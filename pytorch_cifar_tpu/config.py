@@ -17,9 +17,8 @@ from typing import Optional, Tuple
 
 @dataclass
 class TrainConfig:
-    # model
-    # reference default is SimpleDLA (main.py:71); ResNet18 until DLA lands
-    model: str = "ResNet18"
+    # model (reference default: SimpleDLA, main.py:71)
+    model: str = "SimpleDLA"
     num_classes: int = 10
 
     # optimization (reference recipe: main.py:86-89)
@@ -55,7 +54,7 @@ class TrainConfig:
     # misc
     seed: int = 0
     log_every: int = 50
-    profile: bool = False  # optional jax.profiler trace of a few steps
+    profile: bool = False  # jax.profiler trace of ~20 steady-state steps
 
     @property
     def t_max(self) -> int:

@@ -138,6 +138,8 @@ class Trainer:
             self.mesh,
         )
         self.rng = jax.random.PRNGKey(config.seed + 1)
+        self._trace_dir = None  # set by fit() for the profiled epoch
+        self.profile_steps = 20
 
     # ------------------------------------------------------------------
 
@@ -150,9 +152,16 @@ class Trainer:
         # fold the epoch into the rng: deterministic, distinct shuffles &
         # augmentations per epoch (the reference's missing set_epoch fix)
         rng = jax.random.fold_in(self.rng, epoch)
+        trace_end = min(self.profile_steps, nb) if self._trace_dir else 0
         t0 = time.time()
         for i, batch in enumerate(self.loader.epoch(epoch)):
+            if trace_end and i == 0:
+                jax.profiler.start_trace(self._trace_dir)
             state, metrics = self.train_step(state, batch, rng)
+            if trace_end and i + 1 == trace_end:
+                jax.device_get(metrics)  # drain the async queue into the trace
+                jax.profiler.stop_trace()
+                trace_end = 0
             totals = (
                 metrics
                 if totals is None
@@ -235,8 +244,15 @@ class Trainer:
             self.global_batch,
             self.steps_per_epoch,
         )
+        # trace a bounded window of the second epoch (steady state, no compile
+        # events) — or of the only epoch when just one runs. The reference has
+        # no profiler at all (SURVEY.md §5).
+        profile_epoch = min(self.start_epoch + 1, cfg.epochs - 1)
         for epoch in range(self.start_epoch, cfg.epochs):
+            if cfg.profile and epoch == profile_epoch and is_primary():
+                self._trace_dir = f"{cfg.output_dir}/profile"
             self.train_epoch(epoch)
+            self._trace_dir = None
             _, acc = self.eval_epoch(epoch)
             self.maybe_checkpoint(epoch, acc)
         return self.best_acc

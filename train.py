@@ -2,7 +2,7 @@
 """CIFAR-10 training CLI (the reference's main.py/main_dist.py unified).
 
 Examples:
-    python train.py                                 # ResNet18, 1 chip/all chips
+    python train.py                                 # SimpleDLA, 1 chip/all chips
     python train.py --model ResNet50 --batch_size 1024
     python train.py --resume --output_dir ./checkpoint
     python train.py --synthetic_data --epochs 2     # no-dataset smoke run

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Canonical launch wrapper (parity: reference train.sh:3-7, which pins
+# batch 1024 + an output dir and forwards extra flags). No --workers flag
+# here: augmentation runs on device inside the jitted step, so there is no
+# host worker pool to size.
+
+python3 train.py \
+  --batch_size 1024 \
+  --output_dir ./test \
+  "$@"
