@@ -167,6 +167,11 @@ class Trainer:
                 if totals is None
                 else jax.tree_util.tree_map(jnp.add, totals, metrics)
             )
+            if trace_end and i + 1 <= trace_end:
+                # no per-step TTY sync inside the trace window: a device_get
+                # each step blocks dispatch run-ahead and the trace would
+                # show sync gaps that don't exist in production steps
+                continue
             if (
                 i % self.config.log_every == 0
                 or i + 1 == nb

@@ -5,7 +5,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from pytorch_cifar_tpu.data.augment import augment_batch, normalize, random_crop, random_hflip
+from pytorch_cifar_tpu.data.augment import (
+    augment_batch,
+    crop_flip_onehot,
+    normalize,
+    random_crop,
+    random_hflip,
+)
 from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
 from pytorch_cifar_tpu.data.pipeline import Dataloader, eval_batches
 
@@ -51,6 +57,32 @@ def test_random_hflip_is_flip_or_identity():
     for i in range(4):
         ok = np.array_equal(out[i], x[i]) or np.array_equal(out[i], x[i, :, ::-1])
         assert ok
+
+
+def test_crop_flip_onehot_matches_gather_path():
+    """The MXU one-hot formulation must be bit-identical to the reference
+    dynamic_slice crop + where-select flip under the same key."""
+    key = jax.random.PRNGKey(7)
+    x = jax.random.randint(key, (16, 32, 32, 3), 0, 256, jnp.int32).astype(
+        jnp.uint8
+    )
+    kc, kf = jax.random.split(key)
+    ref = random_hflip(kf, random_crop(kc, x)).astype(jnp.float32)
+    fused = crop_flip_onehot(key, x, flip=True)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
+    # crop-only variant
+    ref_c = random_crop(kc, x).astype(jnp.float32)
+    fused_c = crop_flip_onehot(key, x, flip=False)
+    np.testing.assert_array_equal(np.asarray(fused_c), np.asarray(ref_c))
+    # non-square input: selectors must use height/width independently
+    xr = jax.random.randint(key, (4, 16, 48, 3), 0, 256, jnp.int32).astype(
+        jnp.uint8
+    )
+    kcr, _ = jax.random.split(key)
+    np.testing.assert_array_equal(
+        np.asarray(crop_flip_onehot(key, xr, flip=False)),
+        np.asarray(random_crop(kcr, xr).astype(jnp.float32)),
+    )
 
 
 def test_augment_batch_dtype():
