@@ -36,6 +36,10 @@ class TrainConfig:
     synthetic_data: bool = False  # run without the CIFAR-10 archive
     random_crop: bool = True  # main.py:31 (the dist path drops it; we keep it)
     random_flip: bool = True
+    # crop+flip on the host via the native C++ data plane instead of inside
+    # the jitted step — for CPU-only training where device augmentation
+    # competes with model compute (native/cifar_native.cpp)
+    host_augment: bool = False
     mean: Tuple[float, float, float] = (0.4914, 0.4822, 0.4465)  # main.py:34
     std: Tuple[float, float, float] = (0.2023, 0.1994, 0.2010)
 

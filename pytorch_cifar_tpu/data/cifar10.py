@@ -21,6 +21,7 @@ import numpy as np
 
 CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
 _DIRNAME = "cifar-10-batches-py"
+_BIN_DIRNAME = "cifar-10-batches-bin"
 
 Arrays = Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
 
@@ -46,22 +47,57 @@ def _load_from_dir(batches_dir: str) -> Arrays:
     return train_x, train_y, test_x, test_y
 
 
+def _load_from_bin_dir(bin_dir: str) -> Arrays:
+    """The cifar-10-binary.tar.gz layout (3073-byte records), decoded by the
+    native data plane (planar CHW -> NHWC in C++/OpenMP, with a numpy
+    fallback — native/cifar_native.cpp)."""
+    from pytorch_cifar_tpu.native import decode_cifar_records
+
+    def read_records(path):
+        with open(path, "rb") as f:
+            buf = f.read()
+        if not buf or len(buf) % 3073:
+            # a partially-extracted file must not silently yield a
+            # truncated dataset (same hazard _find_dataset guards for the
+            # pickle layout)
+            raise ValueError(
+                f"{path}: size {len(buf)} is not a whole number of "
+                "3073-byte CIFAR records — archive truncated?"
+            )
+        return decode_cifar_records(buf)
+
+    xs, ys = [], []
+    for i in range(1, 6):
+        x, y = read_records(os.path.join(bin_dir, f"data_batch_{i}.bin"))
+        xs.append(x)
+        ys.append(y)
+    test_x, test_y = read_records(os.path.join(bin_dir, "test_batch.bin"))
+    return np.concatenate(xs), np.concatenate(ys), test_x, test_y
+
+
 def _find_dataset(data_dir: str):
-    candidates = [
-        os.path.join(data_dir, _DIRNAME),
-        os.path.join(data_dir, "cifar10", _DIRNAME),
-        os.path.expanduser("~/data/" + _DIRNAME),
-        "/root/data/" + _DIRNAME,
-    ]
+    """Returns (path, kind) for the first complete archive found; kind is
+    'py' (pickle batches) or 'bin' (binary records). Each candidate root is
+    probed for both layouts, including $CIFAR10_PATH."""
+    roots = [data_dir, os.path.join(data_dir, "cifar10"),
+             os.path.expanduser("~/data"), "/root/data"]
+    required = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
+    candidates = []
     env = os.environ.get("CIFAR10_PATH")
     if env:
-        candidates.insert(0, env)
-    required = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
-    for c in candidates:
+        # the env var may point at the batch dir itself, either layout
+        candidates += [(env, "py"), (env, "bin")]
+    for r in roots:
+        candidates.append((os.path.join(r, _DIRNAME), "py"))
+        candidates.append((os.path.join(r, _BIN_DIRNAME), "bin"))
+    for c, kind in candidates:
+        suffix = ".bin" if kind == "bin" else ""
         # all six batch files must exist — a partially-extracted directory
         # (e.g. ENOSPC mid-extraction) must not be mistaken for the dataset
-        if all(os.path.isfile(os.path.join(c, f)) for f in required):
-            return c
+        if all(
+            os.path.isfile(os.path.join(c, f + suffix)) for f in required
+        ):
+            return c, kind
     return None
 
 
@@ -122,9 +158,11 @@ def synthetic_cifar10(
 def load_cifar10(data_dir: str = "./data", synthetic_ok: bool = True) -> Arrays:
     found = _find_dataset(data_dir)
     if found is None:
-        found = _try_download(data_dir)
+        path = _try_download(data_dir)
+        found = (path, "py") if path is not None else None
     if found is not None:
-        return _load_from_dir(found)
+        path, kind = found
+        return _load_from_dir(path) if kind == "py" else _load_from_bin_dir(path)
     if synthetic_ok:
         import logging
 

@@ -23,6 +23,8 @@ from typing import Iterator, Optional, Tuple
 import jax
 import numpy as np
 
+from pytorch_cifar_tpu.native import augment_batch_u8, gather_batch
+
 
 class Dataloader:
     """Iterates (images_uint8, labels_int32) device batches for one epoch."""
@@ -37,10 +39,18 @@ class Dataloader:
         seed: int = 0,
         sharding: Optional[jax.sharding.Sharding] = None,
         prefetch: int = 2,
+        host_augment: bool = False,
+        augment_padding: int = 4,
+        augment_flip: bool = True,
     ):
         assert images.shape[0] == labels.shape[0]
-        self.images = images
-        self.labels = labels
+        # normalize once so the native gather's zero-copy fast path applies
+        # to every batch (gather_batch falls back to numpy indexing for
+        # non-contiguous or non-canonical dtypes)
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(
+            labels, np.int32 if labels.dtype.kind in "iu" else labels.dtype
+        )
         self.batch_size = batch_size
         self.shuffle = shuffle
         # Like the reference's drop_last=False default, a ragged final batch
@@ -50,6 +60,12 @@ class Dataloader:
         self.seed = seed
         self.sharding = sharding
         self.prefetch = max(1, prefetch)
+        # CPU-mode augmentation in the native data plane (crop+flip on the
+        # host, native/cifar_native.cpp) — used with a train step built with
+        # augment=False; on TPU the on-device path (augment.py) is faster
+        self.host_augment = host_augment
+        self.augment_padding = augment_padding
+        self.augment_flip = augment_flip
 
     def __len__(self) -> int:
         n = self.images.shape[0]
@@ -65,11 +81,25 @@ class Dataloader:
             order = np.arange(n)
         nb = len(self)
 
+        aug_rng = np.random.RandomState(
+            (self.seed * 9973 + epoch * 31 + 7) % (2**31)
+        )
+
         def host_batches():
             for b in range(nb):
                 idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-                x = self.images[idx]
-                y = self.labels[idx]
+                # native parallel gather (OpenMP memcpy, GIL released) with a
+                # numpy fancy-indexing fallback — native/cifar_native.cpp
+                x, y = gather_batch(self.images, self.labels, idx)
+                if self.host_augment:
+                    n, pad = x.shape[0], self.augment_padding
+                    x = augment_batch_u8(
+                        x,
+                        aug_rng.randint(0, 2 * pad + 1, n),
+                        aug_rng.randint(0, 2 * pad + 1, n),
+                        aug_rng.randint(0, 2 if self.augment_flip else 1, n),
+                        padding=pad,
+                    )
                 if not self.drop_last and x.shape[0] < self.batch_size:
                     pad = self.batch_size - x.shape[0]
                     x = np.concatenate([x, np.zeros_like(x[:1]).repeat(pad, 0)])

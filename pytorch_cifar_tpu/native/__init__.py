@@ -1,0 +1,184 @@
+"""ctypes bindings for the native host-side data plane (cifar_native.cpp).
+
+The shared library is built on demand with g++ (cached next to the source);
+every entry point has a pure-numpy fallback so the framework runs unchanged
+where no toolchain exists. ``native_available()`` reports which path is live.
+
+Python<->C++ binding is ctypes over a flat C ABI — the image has no pybind11
+(environment constraint); ctypes releases the GIL during calls, so the
+OpenMP gather/decode/augment overlap with device dispatch from the training
+thread.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "cifar_native.cpp")
+_SO = os.path.join(_DIR, "cifar_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    # unique temp per process: concurrent builders (multi-process launch,
+    # parallel pytest) must not interleave writes into one file
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        fresh = not os.path.isfile(_SO) or os.path.getmtime(
+            _SO
+        ) < os.path.getmtime(_SRC)
+        if fresh and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _build_failed = True
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64 = ctypes.c_int64
+        lib.gather_batch.argtypes = [u8p, i32p, i64, i64, u8p]
+        lib.gather_labels.argtypes = [i32p, i32p, i64, i32p]
+        lib.decode_cifar_records.argtypes = [u8p, i64, u8p, i32p]
+        lib.augment_batch_u8.argtypes = [
+            u8p, i64, i64, i64, i64, i64, i32p, i32p, u8p, u8p,
+        ]
+        lib.native_num_threads.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _u8(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def gather_batch(
+    images: np.ndarray, labels: np.ndarray, idx: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Contiguous (images[idx], labels[idx]); native parallel memcpy when
+    available, numpy fancy indexing otherwise."""
+    lib = _load()
+    if (
+        lib is None
+        or images.dtype != np.uint8
+        or not images.flags["C_CONTIGUOUS"]
+        or labels.dtype != np.int32
+        or not labels.flags["C_CONTIGUOUS"]
+    ):
+        # don't silently copy/convert whole datasets per call — numpy
+        # indexing is the right tool for non-canonical inputs (Dataloader
+        # normalizes once at construction)
+        return images[idx], labels[idx]
+    idx = np.ascontiguousarray(idx, np.int32)
+    if idx.size and (idx.min() < 0 or idx.max() >= images.shape[0]):
+        # preserve numpy fancy-indexing's bounds contract; the C path
+        # would memcpy from out-of-range addresses
+        raise IndexError(
+            f"index out of range [0, {images.shape[0]}) in gather_batch"
+        )
+    batch = idx.shape[0]
+    image_bytes = int(np.prod(images.shape[1:]))
+    out_x = np.empty((batch,) + images.shape[1:], np.uint8)
+    out_y = np.empty((batch,), np.int32)
+    lib.gather_batch(_u8(images), _i32(idx), batch, image_bytes, _u8(out_x))
+    lib.gather_labels(_i32(labels), _i32(idx), batch, _i32(out_y))
+    return out_x, out_y
+
+
+def decode_cifar_records(records: bytes | np.ndarray):
+    """CIFAR-10 binary records (3073 B each, planar CHW) -> NHWC uint8 +
+    int32 labels."""
+    buf = np.frombuffer(records, np.uint8) if isinstance(records, bytes) else records
+    buf = np.ascontiguousarray(buf, np.uint8)
+    n = buf.size // 3073
+    lib = _load()
+    if lib is None:
+        recs = buf[: n * 3073].reshape(n, 3073)
+        labels = recs[:, 0].astype(np.int32)
+        images = (
+            recs[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+        )
+        return images, labels
+    images = np.empty((n, 32, 32, 3), np.uint8)
+    labels = np.empty((n,), np.int32)
+    lib.decode_cifar_records(_u8(buf), n, _u8(images), _i32(labels))
+    return images, labels
+
+
+def augment_batch_u8(
+    images: np.ndarray,
+    off_h: np.ndarray,
+    off_w: np.ndarray,
+    flip: np.ndarray,
+    padding: int = 4,
+) -> np.ndarray:
+    """Host-side crop+flip (uint8): the CPU-mode analogue of the on-device
+    augmentation; offsets in [0, 2*padding], flip is a 0/1 mask."""
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    lib = _load()
+    off_h = np.ascontiguousarray(off_h, np.int32)
+    off_w = np.ascontiguousarray(off_w, np.int32)
+    flip = np.ascontiguousarray(flip, np.uint8)
+    if lib is None:
+        padded = np.zeros((n, h + 2 * padding, w + 2 * padding, c), np.uint8)
+        padded[:, padding : padding + h, padding : padding + w] = images
+        out = np.empty_like(images)
+        for b in range(n):
+            img = padded[b, off_h[b] : off_h[b] + h, off_w[b] : off_w[b] + w]
+            out[b] = img[:, ::-1] if flip[b] else img
+        return out
+    out = np.empty_like(images)
+    lib.augment_batch_u8(
+        _u8(images), n, h, w, c, padding, _i32(off_h), _i32(off_w),
+        _u8(flip), _u8(out),
+    )
+    return out
+
+
+def native_num_threads() -> int:
+    lib = _load()
+    return int(lib.native_num_threads()) if lib is not None else 0

@@ -79,6 +79,8 @@ class Trainer:
             shuffle=True,
             seed=config.seed,
             sharding=sharding,
+            host_augment=config.host_augment and config.random_crop,
+            augment_flip=config.random_flip,
         )
         self.eval_bs = eval_bs
         self.sharding = sharding
@@ -117,10 +119,11 @@ class Trainer:
 
         # -- compiled steps -------------------------------------------
         compute = jnp.bfloat16 if config.amp else jnp.float32
+        device_augment = not self.loader.host_augment
         self.train_step = data_parallel_train_step(
             make_train_step(
-                crop=config.random_crop,
-                flip=config.random_flip,
+                crop=config.random_crop and device_augment,
+                flip=config.random_flip and device_augment,
                 mean=config.mean,
                 std=config.std,
                 compute_dtype=compute,
@@ -167,7 +170,7 @@ class Trainer:
                 if totals is None
                 else jax.tree_util.tree_map(jnp.add, totals, metrics)
             )
-            if trace_end and i + 1 <= trace_end:
+            if trace_end:
                 # no per-step TTY sync inside the trace window: a device_get
                 # each step blocks dispatch run-ahead and the trace would
                 # show sync gaps that don't exist in production steps

@@ -1,0 +1,150 @@
+"""Native data-plane tests: C++ paths vs numpy references, fallback parity.
+
+The C++ library (native/cifar_native.cpp) is the TPU-framework analogue of
+the reference's torch DataLoader worker pool (SURVEY.md §2.3); every entry
+point must be bit-identical to its numpy fallback.
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_cifar_tpu import native
+
+
+def test_native_builds_and_loads():
+    # g++ is part of the baked toolchain; the library must build here
+    assert native.native_available()
+    assert native.native_num_threads() >= 1
+
+
+def test_gather_batch_matches_numpy():
+    rs = np.random.RandomState(0)
+    images = rs.randint(0, 256, (100, 32, 32, 3), dtype=np.uint8)
+    labels = rs.randint(0, 10, (100,)).astype(np.int32)
+    idx = rs.permutation(100)[:32]
+    x, y = native.gather_batch(images, labels, idx)
+    np.testing.assert_array_equal(x, images[idx])
+    np.testing.assert_array_equal(y, labels[idx])
+    assert x.flags["C_CONTIGUOUS"]
+
+
+def test_decode_cifar_records_matches_numpy():
+    rs = np.random.RandomState(1)
+    n = 7
+    records = rs.randint(0, 256, (n, 3073), dtype=np.uint8)
+    records[:, 0] = rs.randint(0, 10, n)
+    x, y = native.decode_cifar_records(records.tobytes())
+    # reference decode: label byte + planar CHW -> NHWC
+    exp_y = records[:, 0].astype(np.int32)
+    exp_x = records[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
+    np.testing.assert_array_equal(y, exp_y)
+    np.testing.assert_array_equal(x, exp_x)
+
+
+def test_augment_batch_u8_matches_numpy_reference():
+    rs = np.random.RandomState(2)
+    n, pad = 16, 4
+    images = rs.randint(0, 256, (n, 32, 32, 3), dtype=np.uint8)
+    off_h = rs.randint(0, 2 * pad + 1, n).astype(np.int32)
+    off_w = rs.randint(0, 2 * pad + 1, n).astype(np.int32)
+    flip = rs.randint(0, 2, n).astype(np.uint8)
+    out = native.augment_batch_u8(images, off_h, off_w, flip, padding=pad)
+
+    padded = np.zeros((n, 40, 40, 3), np.uint8)
+    padded[:, pad:-pad, pad:-pad] = images
+    for b in range(n):
+        ref = padded[b, off_h[b] : off_h[b] + 32, off_w[b] : off_w[b] + 32]
+        if flip[b]:
+            ref = ref[:, ::-1]
+        np.testing.assert_array_equal(out[b], ref)
+
+
+def test_dataloader_uses_gather_path():
+    from pytorch_cifar_tpu.data.pipeline import Dataloader
+
+    x = np.arange(64, dtype=np.uint8)[:, None, None, None].repeat(2, 1)
+    x = np.ascontiguousarray(np.broadcast_to(x, (64, 2, 2, 3)))
+    y = np.arange(64, dtype=np.int32)
+    dl = Dataloader(x, y, batch_size=8, seed=0)
+    for bx, by in dl.epoch(0):
+        bx, by = np.asarray(bx), np.asarray(by)
+        # image content must track the gathered labels exactly
+        np.testing.assert_array_equal(bx[:, 0, 0, 0], by.astype(np.uint8))
+
+
+def test_dataloader_host_augment():
+    """host_augment applies native crop+flip per batch, deterministically
+    per (seed, epoch)."""
+    from pytorch_cifar_tpu.data.pipeline import Dataloader
+
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 256, (64, 32, 32, 3), dtype=np.uint8)
+    y = np.arange(64, dtype=np.int32)
+    dl = Dataloader(x, y, batch_size=16, seed=1, host_augment=True)
+    plain = Dataloader(x, y, batch_size=16, seed=1)
+    a0 = [np.asarray(b[0]) for b in dl.epoch(0)]
+    a0b = [np.asarray(b[0]) for b in dl.epoch(0)]
+    p0 = [np.asarray(b[0]) for b in plain.epoch(0)]
+    for a, b in zip(a0, a0b):
+        np.testing.assert_array_equal(a, b)  # deterministic
+    assert any(
+        not np.array_equal(a, p) for a, p in zip(a0, p0)
+    )  # actually augmenting
+
+
+def test_gather_batch_bounds_check():
+    images = np.zeros((4, 2, 2, 3), np.uint8)
+    labels = np.zeros((4,), np.int32)
+    if native.native_available():
+        with pytest.raises(IndexError):
+            native.gather_batch(images, labels, np.array([0, 7]))
+
+
+def test_augment_u8_fallback_padding_edge():
+    """numpy fallback must handle padding=0 like the native path."""
+    rs = np.random.RandomState(5)
+    images = rs.randint(0, 256, (3, 8, 8, 3), dtype=np.uint8)
+    zeros = np.zeros(3, np.int32)
+    out_native = native.augment_batch_u8(
+        images, zeros, zeros, np.zeros(3, np.uint8), padding=0
+    )
+    np.testing.assert_array_equal(out_native, images)
+
+
+def test_decode_bin_truncated_raises(tmp_path):
+    from pytorch_cifar_tpu.data.cifar10 import _load_from_bin_dir
+
+    bin_dir = tmp_path / "bins"
+    bin_dir.mkdir()
+    for i in range(1, 6):
+        (bin_dir / f"data_batch_{i}.bin").write_bytes(b"\x00" * 3073)
+    (bin_dir / "test_batch.bin").write_bytes(b"\x00" * 1000)  # truncated
+    with pytest.raises(ValueError):
+        _load_from_bin_dir(str(bin_dir))
+
+
+def test_decode_bin_dir_roundtrip(tmp_path):
+    """load_cifar10 reads the binary layout through the native decoder."""
+    from pytorch_cifar_tpu.data.cifar10 import load_cifar10
+
+    rs = np.random.RandomState(3)
+    bin_dir = tmp_path / "cifar-10-batches-bin"
+    bin_dir.mkdir()
+    per = 5
+    all_train = []
+    for i in range(1, 6):
+        recs = rs.randint(0, 256, (per, 3073), dtype=np.uint8)
+        recs[:, 0] = rs.randint(0, 10, per)
+        (bin_dir / f"data_batch_{i}.bin").write_bytes(recs.tobytes())
+        all_train.append(recs)
+    test = rs.randint(0, 256, (per, 3073), dtype=np.uint8)
+    test[:, 0] = rs.randint(0, 10, per)
+    (bin_dir / "test_batch.bin").write_bytes(test.tobytes())
+
+    tx, ty, vx, vy = load_cifar10(str(tmp_path), synthetic_ok=False)
+    assert tx.shape == (25, 32, 32, 3) and vx.shape == (5, 32, 32, 3)
+    exp = np.concatenate(all_train)
+    np.testing.assert_array_equal(ty, exp[:, 0].astype(np.int32))
+    np.testing.assert_array_equal(
+        tx, exp[:, 1:].reshape(25, 3, 32, 32).transpose(0, 2, 3, 1)
+    )
