@@ -44,6 +44,50 @@ def build_step(model_name: str, batch: int, compute_dtype):
     return state, step
 
 
+# BASELINE.json configs 1-5 as (models, global batch). Config 1 is the CPU
+# LeNet point; 3-5 are the v4-8/v4-32 sweeps, which on a single chip run at
+# the same global batch (the driver's multi-chip dryrun covers the sharding).
+CONFIGS = {
+    1: (["LeNet"], 128),
+    2: (["ResNet18"], 512),
+    3: (["ResNet50", "PreActResNet50"], 1024),
+    4: (["MobileNetV2", "EfficientNetB0"], 512),
+    5: (["DenseNet121", "RegNetX_200MF", "SimpleDLA"], 512),
+}
+
+
+def run_one(model: str, batch: int, steps: int, warmup: int, compute_dtype):
+    state, step = build_step(model, batch, compute_dtype)
+    rs = np.random.RandomState(0)
+    batches = [
+        (
+            jax.device_put(
+                rs.randint(0, 256, size=(batch, 32, 32, 3), dtype=np.uint8)
+            ),
+            jax.device_put(rs.randint(0, 10, size=(batch,)).astype(np.int32)),
+        )
+        for _ in range(4)
+    ]
+    rng = jax.random.PRNGKey(42)
+    # Sync via D2H fetch of a metric: under some remote-TPU transports
+    # (axon tunnel) block_until_ready returns before execution finishes, but
+    # a device->host value transfer cannot. Steps chain through the donated
+    # state, so fetching the last step's metric waits for the whole run.
+    metrics = None
+    for i in range(warmup):
+        state, metrics = step(state, batches[i % len(batches)], rng)
+    if metrics is not None:
+        float(metrics["loss_sum"])
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, batches[i % len(batches)], rng)
+    loss_sum = float(metrics["loss_sum"])
+    elapsed = time.perf_counter() - t0
+    loss = loss_sum / float(metrics["count"])
+    assert np.isfinite(loss), f"non-finite loss {loss} for {model}"
+    return steps * batch / elapsed
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default="ResNet18")
@@ -51,6 +95,10 @@ def main() -> int:
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--warmup", type=int, default=10)
     parser.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    parser.add_argument(
+        "--config", type=int, choices=sorted(CONFIGS), default=None,
+        help="run a BASELINE.json config preset instead of --model/--batch",
+    )
     args = parser.parse_args()
 
     platform = jax.devices()[0].platform
@@ -61,52 +109,30 @@ def main() -> int:
         args.warmup = min(args.warmup, 2)
 
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    state, step = build_step(args.model, args.batch, compute_dtype)
 
-    # Pre-staged device batches (synthetic uint8 CIFAR shapes; throughput is
-    # content-independent). A few distinct buffers so no step reuses a
-    # donated input.
-    rs = np.random.RandomState(0)
-    batches = [
-        (
-            jax.device_put(
-                rs.randint(0, 256, size=(args.batch, 32, 32, 3), dtype=np.uint8)
-            ),
-            jax.device_put(rs.randint(0, 10, size=(args.batch,)).astype(np.int32)),
+    if args.config is not None:
+        models, batch = CONFIGS[args.config]
+        batch = min(batch, args.batch) if platform == "cpu" else batch
+        rates = [
+            run_one(m, batch, args.steps, args.warmup, compute_dtype)
+            for m in models
+        ]
+        # one number per config: geometric mean across its models
+        value = float(np.exp(np.mean(np.log(rates))))
+        name = f"config{args.config}_" + "+".join(models) + f"_b{batch}"
+    else:
+        # The jitted step runs on a single device (default placement, no
+        # sharding), so per-chip throughput == measured throughput
+        # regardless of how many chips the host exposes.
+        value = run_one(
+            args.model, args.batch, args.steps, args.warmup, compute_dtype
         )
-        for _ in range(4)
-    ]
-    rng = jax.random.PRNGKey(42)
-
-    # Sync via D2H fetch of a metric: under some remote-TPU transports
-    # (axon tunnel) block_until_ready returns before execution finishes, but a
-    # device->host value transfer cannot. Steps chain through the donated
-    # state, so fetching the last step's metric waits for the whole run.
-    metrics = None
-    for i in range(args.warmup):
-        state, metrics = step(state, batches[i % len(batches)], rng)
-    if metrics is not None:
-        float(metrics["loss_sum"])
-
-    t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, metrics = step(state, batches[i % len(batches)], rng)
-    loss_sum = float(metrics["loss_sum"])
-    elapsed = time.perf_counter() - t0
-
-    loss = loss_sum / float(metrics["count"])
-    assert np.isfinite(loss), f"non-finite loss {loss}"
-
-    # The jitted step runs on a single device (default placement, no
-    # sharding), so per-chip throughput == measured throughput regardless of
-    # how many chips the host exposes.
-    images_per_sec = args.steps * args.batch / elapsed
-    value = images_per_sec
+        name = f"train_throughput_{args.model}_b{args.batch}"
 
     print(
         json.dumps(
             {
-                "metric": f"train_throughput_{args.model}_b{args.batch}_{args.dtype}_{platform}",
+                "metric": f"{name}_{args.dtype}_{platform}",
                 "value": round(value, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": 1.0,

@@ -54,6 +54,7 @@ class TrainConfig:
     # checkpointing (reference: main.py:136-148)
     output_dir: str = "./checkpoint"
     resume: bool = False
+    evaluate: bool = False  # load the checkpoint, run eval only, no training
 
     # misc
     seed: int = 0

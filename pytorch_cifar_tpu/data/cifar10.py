@@ -131,6 +131,21 @@ def _try_download(data_dir: str):
         return None
 
 
+def get_mean_and_std(images: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel mean/std of a uint8 NHWC dataset, in [0,1] units.
+
+    The reference ships a broken, never-called version (utils.py:16-28
+    references torch.utils.data without importing torch — SURVEY.md §2.5.2)
+    that also averages per-image stds rather than computing the dataset std.
+    This is the working equivalent: exact dataset statistics, the same
+    quantities as the hardcoded normalize constants (main.py:34).
+    """
+    x = images.astype(np.float64) / 255.0
+    mean = x.mean(axis=(0, 1, 2))
+    std = x.std(axis=(0, 1, 2))
+    return mean.astype(np.float32), std.astype(np.float32)
+
+
 def synthetic_cifar10(
     n_train: int = 2048, n_test: int = 512, seed: int = 0
 ) -> Arrays:

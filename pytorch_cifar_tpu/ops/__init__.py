@@ -1,0 +1,16 @@
+"""Custom TPU kernels (Pallas) for hot ops.
+
+The compute path defaults to XLA-generated kernels — on TPU the compiler's
+conv/BN/ReLU fusion is already strong, and hand-scheduling what XLA does
+well is an anti-pattern. This package holds the exceptions: kernels where
+explicit VMEM control or fusion beyond XLA's scope pays, each shipped with
+an equivalence test against the lax reference and an honest benchmark.
+"""
+
+from pytorch_cifar_tpu.ops.conv_bn_relu import (
+    conv3x3_bn_relu,
+    conv3x3_bn_relu_reference,
+    fold_batchnorm,
+)
+
+__all__ = ["conv3x3_bn_relu", "conv3x3_bn_relu_reference", "fold_batchnorm"]

@@ -72,19 +72,26 @@ class Trainer:
         eval_bs = max(config.eval_batch_size // n_dev, 1) * n_dev
 
         sharding = batch_sharding(self.mesh)
-        self.loader = Dataloader(
-            tr_x,
-            tr_y,
-            batch_size=self.global_batch,
-            shuffle=True,
-            seed=config.seed,
-            sharding=sharding,
-            host_augment=config.host_augment and config.random_crop,
-            augment_flip=config.random_flip,
-        )
+        if config.evaluate:
+            # eval-only: no shuffling/augmenting loader or train step needed;
+            # steps_per_epoch (which anchors the LR schedule restored from
+            # the checkpoint) derives from the split size directly
+            self.loader = None
+            self.steps_per_epoch = max(tr_x.shape[0] // self.global_batch, 1)
+        else:
+            self.loader = Dataloader(
+                tr_x,
+                tr_y,
+                batch_size=self.global_batch,
+                shuffle=True,
+                seed=config.seed,
+                sharding=sharding,
+                host_augment=config.host_augment and config.random_crop,
+                augment_flip=config.random_flip,
+            )
+            self.steps_per_epoch = len(self.loader)
         self.eval_bs = eval_bs
         self.sharding = sharding
-        self.steps_per_epoch = len(self.loader)
 
         # -- model/optimizer/state ------------------------------------
         self.model = create_model(
@@ -105,7 +112,7 @@ class Trainer:
 
         self.start_epoch = 0
         self.best_acc = 0.0
-        if config.resume:
+        if config.resume or config.evaluate:
             state, self.start_epoch, self.best_acc = restore_checkpoint(
                 config.output_dir, state
             )
@@ -119,7 +126,7 @@ class Trainer:
 
         # -- compiled steps -------------------------------------------
         compute = jnp.bfloat16 if config.amp else jnp.float32
-        device_augment = not self.loader.host_augment
+        device_augment = self.loader is None or not self.loader.host_augment
         self.train_step = data_parallel_train_step(
             make_train_step(
                 crop=config.random_crop and device_augment,
@@ -252,6 +259,9 @@ class Trainer:
             self.global_batch,
             self.steps_per_epoch,
         )
+        if cfg.evaluate:
+            _, acc = self.eval_epoch(max(self.start_epoch - 1, 0))
+            return acc
         # trace a bounded window of the second epoch (steady state, no compile
         # events) — or of the only epoch when just one runs. The reference has
         # no profiler at all (SURVEY.md §5).

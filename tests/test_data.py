@@ -12,7 +12,7 @@ from pytorch_cifar_tpu.data.augment import (
     random_crop,
     random_hflip,
 )
-from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
+from pytorch_cifar_tpu.data.cifar10 import get_mean_and_std, synthetic_cifar10
 from pytorch_cifar_tpu.data.pipeline import Dataloader, eval_batches
 
 
@@ -29,6 +29,17 @@ def test_synthetic_deterministic():
     b = synthetic_cifar10(n_train=64, n_test=16)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x, y)
+
+
+def test_get_mean_and_std_exact():
+    """Known-answer check: constant channels have exact stats."""
+    x = np.zeros((10, 4, 4, 3), np.uint8)
+    x[..., 0] = 255  # channel 0 all ones
+    x[..., 1] = 51  # 0.2
+    x[:5, :, :, 2] = 255  # channel 2: half ones -> mean .5, std .5
+    mean, std = get_mean_and_std(x)
+    np.testing.assert_allclose(mean, [1.0, 0.2, 0.5], atol=1e-6)
+    np.testing.assert_allclose(std, [0.0, 0.0, 0.5], atol=1e-6)
 
 
 def test_normalize_stats():

@@ -1,0 +1,65 @@
+"""Pallas kernel tests (interpret mode — runs on the CPU test platform)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_cifar_tpu.ops import conv3x3_bn_relu, conv3x3_bn_relu_reference
+from pytorch_cifar_tpu.ops.conv_bn_relu import fold_batchnorm
+
+
+@pytest.mark.parametrize("cin,cout,hw", [(8, 16, 8), (16, 8, 4)])
+def test_conv_bn_relu_matches_lax(cin, cout, hw):
+    k = jax.random.PRNGKey(0)
+    kx, kw, kg, kb, km, kv = jax.random.split(k, 6)
+    x = jax.random.normal(kx, (3, hw, hw, cin), jnp.float32)
+    w = jax.random.normal(kw, (3, 3, cin, cout), jnp.float32) * 0.1
+    gamma = jax.random.normal(kg, (cout,)) * 0.5 + 1.0
+    beta = jax.random.normal(kb, (cout,)) * 0.1
+    mean = jax.random.normal(km, (cout,)) * 0.1
+    var = jax.nn.softplus(jax.random.normal(kv, (cout,))) + 0.5
+    scale, bias = fold_batchnorm(gamma, beta, mean, var)
+
+    got = conv3x3_bn_relu(x, w, scale, bias, interpret=True)
+    want = conv3x3_bn_relu_reference(x, w, scale, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_fold_batchnorm_matches_flax_inference():
+    """Folded affine == flax BatchNorm in eval mode."""
+    from flax import linen as nn
+
+    cout = 6
+    k = jax.random.PRNGKey(1)
+    x = jax.random.normal(k, (2, 4, 4, cout))
+    bn = nn.BatchNorm(use_running_average=True, epsilon=1e-5, momentum=0.9)
+    variables = bn.init(k, x)
+    gamma = variables["params"]["scale"]
+    beta = variables["params"]["bias"]
+    mean = jnp.linspace(-1, 1, cout)
+    var = jnp.linspace(0.5, 2, cout)
+    variables = {
+        "params": {"scale": gamma, "bias": beta},
+        "batch_stats": {"mean": mean, "var": var},
+    }
+    want = bn.apply(variables, x)
+    scale, bias = fold_batchnorm(gamma, beta, mean, var)
+    got = x * scale + bias
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_conv_bn_relu_bf16_io():
+    k = jax.random.PRNGKey(2)
+    x = jax.random.normal(k, (2, 8, 8, 8), jnp.bfloat16)
+    w = (jax.random.normal(k, (3, 3, 8, 8)) * 0.1).astype(jnp.bfloat16)
+    ones = jnp.ones((8,), jnp.float32)
+    zeros = jnp.zeros((8,), jnp.float32)
+    got = conv3x3_bn_relu(x, w, ones, zeros, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    want = conv3x3_bn_relu_reference(x, w, ones, zeros)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=0.1, rtol=0.1,
+    )

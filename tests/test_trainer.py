@@ -92,6 +92,22 @@ def test_resume_restores_exact_state(tmp_path):
     assert int(t2.state.step) == int(t1.state.step)
 
 
+def test_evaluate_only_mode(tmp_path):
+    """--evaluate loads the checkpoint and reports eval accuracy without
+    training (extends the reference, which has no eval-only path)."""
+    cfg = small_config(tmp_path, epochs=1)
+    t1 = Trainer(cfg)
+    t1.train_epoch(0)
+    _, acc = t1.eval_epoch(0)
+    t1.maybe_checkpoint(0, acc)
+
+    cfg2 = small_config(tmp_path, evaluate=True)
+    t2 = Trainer(cfg2)
+    got = t2.fit()
+    assert got == pytest.approx(acc)
+    assert int(t2.state.step) == int(t1.state.step)  # no training happened
+
+
 def test_resume_without_checkpoint_raises(tmp_path):
     cfg = small_config(tmp_path, resume=True)
     with pytest.raises(FileNotFoundError):
