@@ -52,7 +52,7 @@ CONFIGS = {
     2: (["ResNet18"], 512),
     3: (["ResNet50", "PreActResNet50"], 1024),
     4: (["MobileNetV2", "EfficientNetB0"], 512),
-    5: (["DenseNet121", "RegNetX_200MF", "SimpleDLA"], 512),
+    5: (["DenseNet121", "RegNetX_200MF", "DLA"], 512),
 }
 
 

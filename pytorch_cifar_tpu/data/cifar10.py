@@ -140,9 +140,9 @@ def get_mean_and_std(images: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     This is the working equivalent: exact dataset statistics, the same
     quantities as the hardcoded normalize constants (main.py:34).
     """
-    x = images.astype(np.float64) / 255.0
-    mean = x.mean(axis=(0, 1, 2))
-    std = x.std(axis=(0, 1, 2))
+    # reduce in float64 without materializing a float64 copy of the dataset
+    mean = images.mean(axis=(0, 1, 2), dtype=np.float64) / 255.0
+    std = images.std(axis=(0, 1, 2), dtype=np.float64) / 255.0
     return mean.astype(np.float32), std.astype(np.float32)
 
 

@@ -72,6 +72,9 @@ class Trainer:
         eval_bs = max(config.eval_batch_size // n_dev, 1) * n_dev
 
         sharding = batch_sharding(self.mesh)
+        # single source of truth for where augmentation runs: host pipeline
+        # (native data plane) vs on-device prologue of the train step
+        host_aug = config.host_augment and config.random_crop
         if config.evaluate:
             # eval-only: no shuffling/augmenting loader or train step needed;
             # steps_per_epoch (which anchors the LR schedule restored from
@@ -86,7 +89,7 @@ class Trainer:
                 shuffle=True,
                 seed=config.seed,
                 sharding=sharding,
-                host_augment=config.host_augment and config.random_crop,
+                host_augment=host_aug,
                 augment_flip=config.random_flip,
             )
             self.steps_per_epoch = len(self.loader)
@@ -126,17 +129,22 @@ class Trainer:
 
         # -- compiled steps -------------------------------------------
         compute = jnp.bfloat16 if config.amp else jnp.float32
-        device_augment = self.loader is None or not self.loader.host_augment
-        self.train_step = data_parallel_train_step(
-            make_train_step(
-                crop=config.random_crop and device_augment,
-                flip=config.random_flip and device_augment,
-                mean=config.mean,
-                std=config.std,
-                compute_dtype=compute,
-                axis_name=DATA_AXIS,
-            ),
-            self.mesh,
+        # on-device augmentation unless the host pipeline already did it
+        device_augment = not host_aug
+        self.train_step = (
+            None
+            if config.evaluate
+            else data_parallel_train_step(
+                make_train_step(
+                    crop=config.random_crop and device_augment,
+                    flip=config.random_flip and device_augment,
+                    mean=config.mean,
+                    std=config.std,
+                    compute_dtype=compute,
+                    axis_name=DATA_AXIS,
+                ),
+                self.mesh,
+            )
         )
         self.eval_step = data_parallel_eval_step(
             make_eval_step(
@@ -154,6 +162,10 @@ class Trainer:
     # ------------------------------------------------------------------
 
     def train_epoch(self, epoch: int) -> Tuple[float, float]:
+        if self.train_step is None:
+            raise RuntimeError(
+                "Trainer was built with evaluate=True; training is disabled"
+            )
         log.info("\nEpoch: %d", epoch)
         state = self.state
         loss_sum = correct = count = 0.0
