@@ -17,3 +17,21 @@ Brand-new framework with the capability surface of the reference
 __version__ = "0.1.0"
 
 from pytorch_cifar_tpu.config import TrainConfig  # noqa: F401
+
+
+def honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu`` effective even when a site-installed TPU
+    plugin overrides it at interpreter startup.
+
+    jax reads the env var into ``jax_platforms`` config, but some device
+    plugins re-register themselves as the default backend regardless; the
+    config-level update (before first backend use) is authoritative. Entry
+    points (train.py, bench.py) call this so a CPU-pinned invocation can
+    never seize the machine's exclusive TPU chip.
+    """
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

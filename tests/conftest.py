@@ -14,13 +14,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
-
 # A site-installed TPU plugin may override jax_platforms in jax.config at
 # interpreter startup (ignoring the env var), which would make every test
 # process pay a multi-minute remote-TPU handshake. Force CPU at the config
-# level before any backend is initialized.
-jax.config.update("jax_platforms", "cpu")
+# level before any backend is initialized (canonical helper).
+from pytorch_cifar_tpu import honor_platform_env  # noqa: E402
+
+honor_platform_env()
+
+import jax  # noqa: E402
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

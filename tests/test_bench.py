@@ -1,0 +1,44 @@
+"""Driver-contract smoke test for bench.py.
+
+The round driver runs ``python bench.py`` and parses exactly ONE JSON line
+``{"metric", "value", "unit", "vs_baseline"}`` from stdout. Pin that
+contract on CPU (LeNet, tiny step budget — the CPU clamp in bench.main
+keeps it fast) so a bench.py regression can't silently break the round's
+recorded benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_prints_one_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
+         "--steps", "2", "--warmup", "1", "--batch", "64"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env=env,
+        check=True,
+    )
+    json_lines = [
+        l for l in out.stdout.splitlines() if l.strip().startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    rec = json.loads(json_lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["value"] > 0
+    assert "LeNet" in rec["metric"]
+    # JAX_PLATFORMS=cpu must be honored — the exclusive TPU chip may be in
+    # use by another process while tests run
+    assert rec["metric"].endswith("_cpu"), rec["metric"]

@@ -8,11 +8,14 @@ Examples:
     python train.py --synthetic_data --epochs 2     # no-dataset smoke run
 """
 
+from pytorch_cifar_tpu import honor_platform_env
 from pytorch_cifar_tpu.config import parse_config
-from pytorch_cifar_tpu.train.trainer import Trainer
 
 
 def main(argv=None) -> float:
+    honor_platform_env()
+    from pytorch_cifar_tpu.train.trainer import Trainer
+
     config = parse_config(argv)
     trainer = Trainer(config)  # installs the logger (primary process only)
     best = trainer.fit()
