@@ -126,32 +126,82 @@ class Dense(nn.Module):
 
 
 class BatchNorm(nn.Module):
-    """BatchNorm matching torch BatchNorm2d defaults.
+    """BatchNorm with torch-exact BatchNorm2d semantics.
 
-    torch: eps=1e-5, momentum=0.1 (new = 0.9*old + 0.1*batch), affine, biased
-    batch variance for normalization. flax BatchNorm momentum is the *keep*
-    factor, so torch momentum 0.1 == flax momentum 0.9.
+    torch (reference, every zoo model): eps=1e-5, momentum=0.1
+    (new = 0.9*old + 0.1*batch), affine; normalization uses the **biased**
+    batch variance while the running-average update uses the **unbiased**
+    one (Bessel n/(n-1)). flax's nn.BatchNorm updates running var with the
+    *biased* variance — a systematic (n-1)/n understatement of the running
+    stats vs the reference at per-device batch n — so the update is
+    implemented inline here instead of delegating.
 
-    Stats live in the ``batch_stats`` collection (the functional equivalent of
-    torch running buffers); they are parameters of neither count nor training.
-    Stats and normalization run in fp32 regardless of compute dtype.
+    Stats live in the ``batch_stats`` collection under the same ``mean`` /
+    ``var`` names flax uses. NOTE: the tree is one level flatter than the
+    earlier delegating version (``.../BatchNorm_0/{scale,bias}``, no nested
+    module) — checkpoints written before this change do not restore.
+    Statistics are computed in fp32; the normalization itself is folded into
+    a per-channel FMA applied in the compute dtype so XLA fuses it into the
+    surrounding convs.
     """
 
     use_running_average: Optional[bool] = None
     dtype: Optional[Dtype] = None
+    momentum: float = 0.1  # torch convention: weight of the NEW batch stat
+    epsilon: float = 1e-5
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
         ura = nn.merge_param(
             "use_running_average", self.use_running_average, use_running_average
         )
-        return nn.BatchNorm(
-            use_running_average=ura,
-            momentum=0.9,
-            epsilon=1e-5,
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-        )(x)
+        features = x.shape[-1]
+        scale = self.param(
+            "scale", nn.initializers.ones, (features,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (features,), jnp.float32
+        )
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if ura:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            axes = tuple(range(x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            # one-pass biased variance normalizes the batch (torch
+            # F.batch_norm); E[x^2]-E[x]^2 keeps it a single fused reduction
+            # clamp: catastrophic cancellation can push the one-pass result
+            # a hair negative for high-mean/low-var channels, and rsqrt of
+            # (negative + eps) would NaN the step
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0
+            )
+            if not self.is_initializing():
+                n = 1
+                for d in axes:
+                    n *= x.shape[d]
+                unbiased = var * (n / max(n - 1, 1))
+                m = self.momentum
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
+                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased
+
+        # fold normalization + affine into one per-channel FMA: the scalar
+        # algebra stays fp32, the elementwise pass runs in the compute dtype
+        # (the bf16 policy's activation dtype), so XLA fuses it into the
+        # surrounding convs like any other epilogue
+        mul = scale * jax.lax.rsqrt(var + self.epsilon)
+        add = bias - mean * mul
+        out_dtype = self.dtype or x.dtype
+        return (
+            x.astype(out_dtype) * mul.astype(out_dtype) + add.astype(out_dtype)
+        )
 
 
 def max_pool(x, window: int, stride: Optional[int] = None, padding: int = 0):

@@ -1,0 +1,111 @@
+"""Torch-exact BatchNorm semantics (models/common.py::BatchNorm).
+
+The reference's models all use torch BatchNorm2d defaults: eps=1e-5,
+momentum=0.1, normalization by the *biased* batch variance, running-average
+update by the *unbiased* (Bessel-corrected) variance. flax's stock
+nn.BatchNorm updates running var with the biased variance, so the framework
+carries its own implementation; these tests pin every piece of the contract
+with pure-numpy expectations (no torch needed at test time).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bn_mod():
+    import jax
+
+    from pytorch_cifar_tpu.models.common import BatchNorm
+
+    return jax, BatchNorm
+
+
+def _numpy_reference(x, momentum=0.1, eps=1e-5):
+    axes = (0, 1, 2)
+    n = x.shape[0] * x.shape[1] * x.shape[2]
+    mean = x.mean(axis=axes)
+    var_b = x.var(axis=axes)  # biased: normalization
+    var_u = var_b * n / (n - 1)  # unbiased: running update
+    y = (x - mean) / np.sqrt(var_b + eps)
+    ra_mean = momentum * mean  # from init 0
+    ra_var = (1 - momentum) * 1.0 + momentum * var_u  # from init 1
+    return y, ra_mean, ra_var
+
+
+def test_train_mode_normalizes_biased_updates_unbiased(bn_mod):
+    jax, BatchNorm = bn_mod
+    x = np.random.RandomState(0).rand(8, 4, 4, 3).astype(np.float32)
+    bn = BatchNorm(use_running_average=False)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    out, mut = bn.apply(variables, x, mutable=["batch_stats"])
+
+    y, ra_mean, ra_var = _numpy_reference(x)
+    np.testing.assert_allclose(np.asarray(out), y, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(mut["batch_stats"]["mean"]), ra_mean, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(mut["batch_stats"]["var"]), ra_var, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_eval_mode_uses_running_stats(bn_mod):
+    jax, BatchNorm = bn_mod
+    x = np.random.RandomState(1).rand(4, 2, 2, 3).astype(np.float32)
+    bn = BatchNorm(use_running_average=True)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    stats = {
+        "mean": np.array([0.1, -0.2, 0.3], np.float32),
+        "var": np.array([0.5, 2.0, 1.0], np.float32),
+    }
+    out = bn.apply(
+        {"params": variables["params"], "batch_stats": stats}, x
+    )
+    expect = (x - stats["mean"]) / np.sqrt(stats["var"] + 1e-5)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_affine_params_applied(bn_mod):
+    jax, BatchNorm = bn_mod
+    x = np.random.RandomState(2).rand(4, 2, 2, 2).astype(np.float32)
+    bn = BatchNorm(use_running_average=True)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    params = {
+        "scale": np.array([2.0, 0.5], np.float32),
+        "bias": np.array([1.0, -1.0], np.float32),
+    }
+    out = bn.apply({"params": params, "batch_stats": variables["batch_stats"]}, x)
+    expect = (x / np.sqrt(1.0 + 1e-5)) * params["scale"] + params["bias"]
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_policy_fp32_stats_and_params(bn_mod):
+    import jax.numpy as jnp
+
+    jax, BatchNorm = bn_mod
+    x = np.random.RandomState(3).rand(8, 4, 4, 3).astype(np.float32)
+    bn = BatchNorm(use_running_average=False, dtype=jnp.bfloat16)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x, jnp.bfloat16))
+    assert variables["params"]["scale"].dtype == jnp.float32
+    assert variables["batch_stats"]["var"].dtype == jnp.float32
+    out, mut = bn.apply(
+        variables, jnp.asarray(x, jnp.bfloat16), mutable=["batch_stats"]
+    )
+    assert out.dtype == jnp.bfloat16
+    assert mut["batch_stats"]["mean"].dtype == jnp.float32
+
+
+def test_init_does_not_update_stats(bn_mod):
+    jax, BatchNorm = bn_mod
+    x = np.random.RandomState(4).rand(8, 4, 4, 3).astype(np.float32) + 5.0
+    bn = BatchNorm(use_running_average=False)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_array_equal(
+        np.asarray(variables["batch_stats"]["mean"]), np.zeros(3, np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(variables["batch_stats"]["var"]), np.ones(3, np.float32)
+    )

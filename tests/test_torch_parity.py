@@ -195,17 +195,20 @@ def record_flax_call_order(model, x):
     import jax
     from flax import linen as nn
 
+    from pytorch_cifar_tpu.models.common import BatchNorm as OurBatchNorm
+
     order = []
     seen = set()
+    bn_types = (nn.BatchNorm, OurBatchNorm)
 
     def interceptor(next_fun, args, kwargs, context):
         m = context.module
         if context.method_name == "__call__" and isinstance(
-            m, (nn.Conv, nn.Dense, nn.BatchNorm)
+            m, (nn.Conv, nn.Dense) + bn_types
         ):
             kind = (
                 "bn"
-                if isinstance(m, nn.BatchNorm)
+                if isinstance(m, bn_types)
                 else "linear" if isinstance(m, nn.Dense) else "conv"
             )
             path = tuple(m.path)
