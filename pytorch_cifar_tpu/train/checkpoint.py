@@ -23,8 +23,12 @@ from flax import serialization
 
 from pytorch_cifar_tpu.train.state import TrainState
 
-CKPT_NAME = "ckpt.msgpack"
-META_NAME = "ckpt.json"
+CKPT_NAME = "ckpt.msgpack"   # best-accuracy checkpoint (reference semantics)
+LAST_NAME = "last.msgpack"   # preemption save: exact latest state
+
+
+def _meta_path(output_dir: str, name: str) -> str:
+    return os.path.join(output_dir, os.path.splitext(name)[0] + ".json")
 
 
 def save_checkpoint(
@@ -55,7 +59,7 @@ def save_checkpoint(
     os.replace(tmp, path)
 
     meta = {"epoch": int(epoch), "best_acc": float(best_acc)}
-    meta_path = os.path.join(output_dir, META_NAME)
+    meta_path = _meta_path(output_dir, name)
     tmp = meta_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(meta, f)
@@ -104,7 +108,7 @@ def restore_checkpoint(
         with open(path, "rb") as f:
             payload = f.read()
         restored = serialization.from_bytes(target, payload)
-        meta_path = os.path.join(output_dir, META_NAME)
+        meta_path = _meta_path(output_dir, name)
         if os.path.isfile(meta_path):
             with open(meta_path) as f:
                 meta = json.load(f)

@@ -10,6 +10,7 @@ shuffled shards, full eval, best-acc-gated checkpoint, per-epoch cosine LR
 from __future__ import annotations
 
 import logging
+import os
 import sys
 import time
 from typing import Tuple
@@ -31,7 +32,12 @@ from pytorch_cifar_tpu.parallel import (
     replicate,
 )
 from pytorch_cifar_tpu.parallel.mesh import is_primary
-from pytorch_cifar_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+from pytorch_cifar_tpu.train.checkpoint import (
+    CKPT_NAME,
+    LAST_NAME,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from pytorch_cifar_tpu.train.optim import make_optimizer
 from pytorch_cifar_tpu.train.state import TrainState, create_train_state
 from pytorch_cifar_tpu.train.steps import make_eval_step, make_train_step
@@ -116,12 +122,29 @@ class Trainer:
         self.start_epoch = 0
         self.best_acc = 0.0
         if config.resume or config.evaluate:
-            state, self.start_epoch, self.best_acc = restore_checkpoint(
-                config.output_dir, state
+            # training resume wants the *newest* state: the preemption save
+            # (last.msgpack) only when it is actually ahead of the best-params
+            # ckpt — a stale one left by an earlier preemption must not roll
+            # training back or clobber the true best via its old best_acc.
+            # Eval-only always wants the best-accuracy params.
+            names = (
+                [CKPT_NAME, LAST_NAME]
+                if config.evaluate
+                else self._resume_order(config.output_dir)
             )
+            for name in names:
+                try:
+                    state, self.start_epoch, self.best_acc = (
+                        restore_checkpoint(config.output_dir, state, name)
+                    )
+                    break
+                except FileNotFoundError:
+                    if name == names[-1]:
+                        raise
             log.info(
-                "resumed from %s: epoch %d, best_acc %.2f",
+                "resumed from %s (%s): epoch %d, best_acc %.2f",
                 config.output_dir,
+                name,
                 self.start_epoch,
                 self.best_acc,
             )
@@ -158,8 +181,31 @@ class Trainer:
         self.rng = jax.random.PRNGKey(config.seed + 1)
         self._trace_dir = None  # set by fit() for the profiled epoch
         self.profile_steps = 20
+        self._stop_requested = False
 
     # ------------------------------------------------------------------
+
+    @staticmethod
+    def _resume_order(output_dir: str):
+        """Checkpoint preference for training resume: whichever of
+        last.msgpack / ckpt.msgpack has the newer epoch in its meta sidecar
+        (ties go to the preemption save — it has the exact latest opt
+        state)."""
+        import json as _json
+
+        def epoch_of(name):
+            path = os.path.join(
+                output_dir, os.path.splitext(name)[0] + ".json"
+            )
+            try:
+                with open(path) as f:
+                    return int(_json.load(f).get("epoch", -1))
+            except (OSError, ValueError):
+                return -1
+
+        if epoch_of(LAST_NAME) >= epoch_of(CKPT_NAME):
+            return [LAST_NAME, CKPT_NAME]
+        return [CKPT_NAME, LAST_NAME]
 
     def train_epoch(self, epoch: int) -> Tuple[float, float]:
         if self.train_step is None:
@@ -278,11 +324,73 @@ class Trainer:
         # events) — or of the only epoch when just one runs. The reference has
         # no profiler at all (SURVEY.md §5).
         profile_epoch = min(self.start_epoch + 1, cfg.epochs - 1)
-        for epoch in range(self.start_epoch, cfg.epochs):
-            if cfg.profile and epoch == profile_epoch and is_primary():
-                self._trace_dir = f"{cfg.output_dir}/profile"
-            self.train_epoch(epoch)
-            self._trace_dir = None
-            _, acc = self.eval_epoch(epoch)
-            self.maybe_checkpoint(epoch, acc)
+        # Preemption safety (SURVEY.md §5: complete checkpoints so preempted
+        # TPU jobs resume exactly): SIGTERM requests a graceful stop — finish
+        # the current epoch, save the exact latest TrainState as last.msgpack
+        # (separate from the best-params ckpt), and return. --resume prefers
+        # it. Signal handlers only attach in the main thread.
+        import signal
+
+        old_handler = None
+        try:
+            old_handler = signal.signal(
+                signal.SIGTERM, lambda s, f: self.request_stop()
+            )
+        except ValueError:
+            pass
+        try:
+            for epoch in range(self.start_epoch, cfg.epochs):
+                if cfg.profile and epoch == profile_epoch and is_primary():
+                    self._trace_dir = f"{cfg.output_dir}/profile"
+                self.train_epoch(epoch)
+                self._trace_dir = None
+                _, acc = self.eval_epoch(epoch)
+                self.maybe_checkpoint(epoch, acc)
+                if self._agreed_stop():
+                    log.info(
+                        "stop requested: saving preemption checkpoint at "
+                        "epoch %d",
+                        epoch,
+                    )
+                    save_checkpoint(
+                        cfg.output_dir,
+                        self.state,
+                        epoch,
+                        self.best_acc,
+                        name=LAST_NAME,
+                    )
+                    break
+            else:
+                # completed normally: a leftover preemption save is now
+                # stale; remove it so a routine relaunch with --resume
+                # cannot roll training back (process-0 writes only)
+                if is_primary() and cfg.output_dir:
+                    for suffix in (LAST_NAME, "last.json"):
+                        try:
+                            os.remove(os.path.join(cfg.output_dir, suffix))
+                        except OSError:
+                            pass
+        finally:
+            if old_handler is not None:
+                signal.signal(signal.SIGTERM, old_handler)
         return self.best_acc
+
+    def _agreed_stop(self) -> bool:
+        """Multi-host agreement on the stop flag: the per-process SIGTERM
+        flag can reach hosts at different epoch boundaries; acting on a
+        divergent value strands the other hosts in a collective. Any host
+        requesting a stop stops all of them (same pattern as the
+        checkpoint-exists broadcast in checkpoint.py)."""
+        if jax.process_count() == 1:
+            return self._stop_requested
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(self._stop_requested, np.int32)
+        )
+        return bool(np.max(flags))
+
+    def request_stop(self) -> None:
+        """Ask fit() to stop after the current epoch and write last.msgpack."""
+        self._stop_requested = True

@@ -317,3 +317,99 @@ def test_forward_parity(name, ref_expr):
 
     assert out.shape == t_out.shape == (4, 10)
     np.testing.assert_allclose(out, t_out, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# full train-step parity: forward + CE loss + backward + SGD(momentum, coupled
+# wd) + BN batch-stat update, one optimizer step, vs torch doing the same
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,ref_expr", [("ResNet18", "ResNet18()")])
+def test_train_step_parity(name, ref_expr):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.data.augment import CIFAR10_MEAN, CIFAR10_STD
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+    from pytorch_cifar_tpu.train.steps import make_train_step
+
+    # lr=0.01 (not the recipe's 0.1): the comparison is of update *algebra*;
+    # a big lr only amplifies fp32 accumulation-order noise between torch
+    # CPU and XLA CPU conv backwards past any meaningful tolerance
+    lr, momentum, wd = 0.01, 0.9, 5e-4
+    ref_models = _ref_models()
+    torch.manual_seed(0)
+    tmodel = eval(ref_expr, {**vars(ref_models)})
+    tmodel.train()
+
+    rs = np.random.RandomState(7)
+    images = rs.randint(0, 256, size=(16, 32, 32, 3), dtype=np.uint8)
+    labels = rs.randint(0, 10, size=(16,)).astype(np.int32)
+
+    # ours: uint8 in, normalize inside the step (augment off)
+    model = create_model(name)
+    x_probe = np.zeros((2, 32, 32, 3), np.float32)
+    call_order, variables = record_flax_call_order(model, x_probe)
+    params = jax.tree_util.tree_map(np.asarray, dict(variables["params"]))
+    stats = jax.tree_util.tree_map(np.asarray, dict(variables["batch_stats"]))
+    # collect torch call order in eval mode: the hook forward must not
+    # perturb BN running stats before the measured step
+    tmodel.eval()
+    params, stats = transplant(
+        tmodel, torch.zeros(2, 3, 32, 32), params, stats, call_order
+    )
+    tmodel.train()
+
+    tx = make_optimizer(lr=lr, momentum=momentum, weight_decay=wd, t_max=200,
+                        steps_per_epoch=100)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    state = state.replace(params=params, batch_stats=stats)
+    step = jax.jit(make_train_step(augment=False))
+    state, metrics = step(state, (images, labels), jax.random.PRNGKey(1))
+    our_loss = float(metrics["loss_sum"]) / float(metrics["count"])
+
+    # torch: identical normalized input, CE mean loss, SGD step
+    mean = np.asarray(CIFAR10_MEAN, np.float32) * 255.0
+    std = np.asarray(CIFAR10_STD, np.float32) * 255.0
+    xn = (images.astype(np.float32) - mean) / std
+    tx_in = torch.from_numpy(np.ascontiguousarray(xn.transpose(0, 3, 1, 2)))
+    opt = torch.optim.SGD(
+        tmodel.parameters(), lr=lr, momentum=momentum, weight_decay=wd
+    )
+    out = tmodel(tx_in)
+    loss = torch.nn.functional.cross_entropy(
+        out, torch.from_numpy(labels.astype(np.int64))
+    )
+    opt.zero_grad()
+    loss.backward()
+    opt.step()
+
+    np.testing.assert_allclose(
+        our_loss, float(loss.detach()), rtol=1e-4, atol=1e-4
+    )
+
+    # expected post-step trees: transplant the *updated* torch model
+    tmodel.eval()
+    exp_params = jax.tree_util.tree_map(np.asarray, dict(variables["params"]))
+    exp_stats = jax.tree_util.tree_map(
+        np.asarray, dict(variables["batch_stats"])
+    )
+    exp_params, exp_stats = transplant(
+        tmodel, tx_in, exp_params, exp_stats, call_order
+    )
+
+    got_params = jax.device_get(state.params)
+    got_stats = jax.device_get(state.batch_stats)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-5),
+        got_params,
+        exp_params,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=5e-5),
+        got_stats,
+        exp_stats,
+    )

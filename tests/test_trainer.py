@@ -118,3 +118,65 @@ def test_non_divisible_batch_rounds_down(tmp_path):
     cfg = small_config(tmp_path, batch_size=100)  # 100 % 8 != 0
     trainer = Trainer(cfg)
     assert trainer.global_batch == 96
+
+
+def test_preemption_checkpoint_roundtrip(tmp_path):
+    """SIGTERM-style stop: fit() saves last.msgpack after the current epoch;
+    --resume prefers it over the best-params ckpt and continues exactly."""
+    from pytorch_cifar_tpu.train.checkpoint import LAST_NAME
+
+    cfg = small_config(tmp_path, epochs=5)
+    tr = Trainer(cfg)
+    tr.request_stop()  # what the SIGTERM handler installed by fit() calls
+    tr.fit()
+    out = cfg.output_dir
+    assert os.path.isfile(os.path.join(out, LAST_NAME))
+    assert os.path.isfile(os.path.join(out, "last.json"))
+    with open(os.path.join(out, "last.json")) as f:
+        meta = json.load(f)
+    assert meta["epoch"] == 0  # stopped after the first epoch
+
+    # resume: picks last.msgpack, continues at epoch 1 with identical params
+    cfg2 = small_config(tmp_path, epochs=5, resume=True)
+    tr2 = Trainer(cfg2)
+    assert tr2.start_epoch == 1
+    p1 = jax.device_get(tr.state.params)
+    p2 = jax.device_get(tr2.state.params)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, p1, p2)
+
+
+def test_evaluate_prefers_best_checkpoint(tmp_path):
+    """Eval-only restores ckpt.msgpack (best params) even when a newer
+    preemption save exists."""
+    cfg = small_config(tmp_path, epochs=1)
+    tr = Trainer(cfg)
+    tr.fit()  # writes best ckpt at epoch 0
+    # fabricate a newer preemption save with different (current) state
+    from pytorch_cifar_tpu.train.checkpoint import LAST_NAME
+
+    save_checkpoint(cfg.output_dir, tr.state, 3, tr.best_acc, name=LAST_NAME)
+
+    cfg2 = small_config(tmp_path, evaluate=True)
+    tr2 = Trainer(cfg2)
+    # best ckpt was epoch 0 -> start_epoch 1 (not the preemption save's 4)
+    assert tr2.start_epoch == 1
+
+
+def test_stale_preemption_save_not_preferred(tmp_path):
+    """A leftover last.msgpack older than the best ckpt must not roll
+    training back on --resume; a completed fit removes it entirely."""
+    from pytorch_cifar_tpu.train.checkpoint import LAST_NAME
+
+    cfg = small_config(tmp_path, epochs=2)
+    tr = Trainer(cfg)
+    # fabricate a stale preemption save BEFORE training completes
+    save_checkpoint(cfg.output_dir, tr.state, 0, 0.0, name=LAST_NAME)
+    tr.fit()  # completes normally -> stale last.* removed
+    assert not os.path.isfile(os.path.join(cfg.output_dir, LAST_NAME))
+    assert not os.path.isfile(os.path.join(cfg.output_dir, "last.json"))
+
+    # re-fabricate: stale last at epoch 0, best ckpt at epoch 1
+    save_checkpoint(cfg.output_dir, tr.state, 0, 0.0, name=LAST_NAME)
+    cfg2 = small_config(tmp_path, epochs=4, resume=True)
+    tr2 = Trainer(cfg2)
+    assert tr2.start_epoch == 2  # resumed the newer best ckpt, not the stale save
