@@ -88,6 +88,51 @@ def run_one(model: str, batch: int, steps: int, warmup: int, compute_dtype):
     return steps * batch / elapsed
 
 
+def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
+    """Host input-pipeline throughput: native gather + host augmentation +
+    sharded device_put, no model step (SURVEY.md §7 hard part #2 — the
+    pipeline must outrun the chips; compare against the model numbers).
+    """
+    from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
+    from pytorch_cifar_tpu.data.pipeline import Dataloader
+    from pytorch_cifar_tpu.parallel import batch_sharding, make_mesh
+
+    n = min(max(batch * 8, 8192), 65_536)
+    if batch > n:
+        raise SystemExit(f"--batch {batch} exceeds the {n}-image bench set")
+    tr_x, tr_y, _, _ = synthetic_cifar10(n_train=n, n_test=8)
+    # same transfer path as the trainer: NamedSharding over the device mesh
+    # (trainer.py builds the loader with exactly this sharding)
+    loader = Dataloader(
+        tr_x,
+        tr_y,
+        batch_size=batch,
+        seed=0,
+        host_augment=host_augment,
+        sharding=batch_sharding(make_mesh()),
+    )
+
+    def drain(epoch):
+        # full epochs only: breaking mid-epoch would abandon staged
+        # prefetch batches whose gather/augment/put cost was already paid
+        # inside the timed window, under-reporting throughput
+        done = 0
+        for x, _ in loader.epoch(epoch):
+            jax.block_until_ready(x)
+            done += 1
+        return done
+
+    drain(0)  # warmup: native build + first device_put + sharding layout
+    t0 = time.perf_counter()
+    done = 0
+    epoch = 1
+    while done < steps:
+        done += drain(epoch)
+        epoch += 1
+    elapsed = time.perf_counter() - t0
+    return done * batch / elapsed
+
+
 def main() -> int:
     from pytorch_cifar_tpu import honor_platform_env
 
@@ -105,6 +150,10 @@ def main() -> int:
         "--config", type=int, choices=sorted(CONFIGS), default=None,
         help="run a BASELINE.json config preset instead of --model/--batch",
     )
+    parser.add_argument(
+        "--pipeline", action="store_true",
+        help="measure host input-pipeline throughput instead of a model",
+    )
     args = parser.parse_args()
 
     platform = jax.devices()[0].platform
@@ -116,7 +165,10 @@ def main() -> int:
 
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
-    if args.config is not None:
+    if args.pipeline:
+        value = run_pipeline(args.batch, max(args.steps, 20))
+        name = f"host_pipeline_b{args.batch}"
+    elif args.config is not None:
         models, batch = CONFIGS[args.config]
         batch = min(batch, args.batch) if platform == "cpu" else batch
         rates = [

@@ -27,7 +27,8 @@ CKPT_NAME = "ckpt.msgpack"   # best-accuracy checkpoint (reference semantics)
 LAST_NAME = "last.msgpack"   # preemption save: exact latest state
 
 
-def _meta_path(output_dir: str, name: str) -> str:
+def meta_path(output_dir: str, name: str) -> str:
+    """Path of the JSON scalar sidecar paired with checkpoint ``name``."""
     return os.path.join(output_dir, os.path.splitext(name)[0] + ".json")
 
 
@@ -59,11 +60,11 @@ def save_checkpoint(
     os.replace(tmp, path)
 
     meta = {"epoch": int(epoch), "best_acc": float(best_acc)}
-    meta_path = _meta_path(output_dir, name)
-    tmp = meta_path + ".tmp"
+    mpath = meta_path(output_dir, name)
+    tmp = mpath + ".tmp"
     with open(tmp, "w") as f:
         json.dump(meta, f)
-    os.replace(tmp, meta_path)
+    os.replace(tmp, mpath)
     return path
 
 
@@ -108,9 +109,9 @@ def restore_checkpoint(
         with open(path, "rb") as f:
             payload = f.read()
         restored = serialization.from_bytes(target, payload)
-        meta_path = _meta_path(output_dir, name)
-        if os.path.isfile(meta_path):
-            with open(meta_path) as f:
+        mpath = meta_path(output_dir, name)
+        if os.path.isfile(mpath):
+            with open(mpath) as f:
                 meta = json.load(f)
             epoch = int(meta.get("epoch", -1))
             best_acc = float(meta.get("best_acc", 0.0))

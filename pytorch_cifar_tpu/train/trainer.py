@@ -35,6 +35,7 @@ from pytorch_cifar_tpu.parallel.mesh import is_primary
 from pytorch_cifar_tpu.train.checkpoint import (
     CKPT_NAME,
     LAST_NAME,
+    meta_path,
     restore_checkpoint,
     save_checkpoint,
 )
@@ -194,11 +195,8 @@ class Trainer:
         import json as _json
 
         def epoch_of(name):
-            path = os.path.join(
-                output_dir, os.path.splitext(name)[0] + ".json"
-            )
             try:
-                with open(path) as f:
+                with open(meta_path(output_dir, name)) as f:
                     return int(_json.load(f).get("epoch", -1))
             except (OSError, ValueError):
                 return -1
@@ -365,9 +363,12 @@ class Trainer:
                 # stale; remove it so a routine relaunch with --resume
                 # cannot roll training back (process-0 writes only)
                 if is_primary() and cfg.output_dir:
-                    for suffix in (LAST_NAME, "last.json"):
+                    for path in (
+                        os.path.join(cfg.output_dir, LAST_NAME),
+                        meta_path(cfg.output_dir, LAST_NAME),
+                    ):
                         try:
-                            os.remove(os.path.join(cfg.output_dir, suffix))
+                            os.remove(path)
                         except OSError:
                             pass
         finally:

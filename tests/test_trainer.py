@@ -175,8 +175,16 @@ def test_stale_preemption_save_not_preferred(tmp_path):
     assert not os.path.isfile(os.path.join(cfg.output_dir, LAST_NAME))
     assert not os.path.isfile(os.path.join(cfg.output_dir, "last.json"))
 
-    # re-fabricate: stale last at epoch 0, best ckpt at epoch 1
+    # deterministic orderings (fabricated epochs, independent of where the
+    # best-acc checkpoint happened to land during the run above):
+    # stale last (epoch 0) vs newer best ckpt (epoch 5) -> ckpt wins
+    save_checkpoint(cfg.output_dir, tr.state, 5, 50.0)
     save_checkpoint(cfg.output_dir, tr.state, 0, 0.0, name=LAST_NAME)
-    cfg2 = small_config(tmp_path, epochs=4, resume=True)
-    tr2 = Trainer(cfg2)
-    assert tr2.start_epoch == 2  # resumed the newer best ckpt, not the stale save
+    tr2 = Trainer(small_config(tmp_path, epochs=9, resume=True))
+    assert tr2.start_epoch == 6
+    assert tr2.best_acc == 50.0
+
+    # tie (same epoch) -> the preemption save wins (exact latest opt state)
+    save_checkpoint(cfg.output_dir, tr.state, 5, 50.0, name=LAST_NAME)
+    tr3 = Trainer(small_config(tmp_path, epochs=9, resume=True))
+    assert tr3.start_epoch == 6
