@@ -167,7 +167,9 @@ def main() -> int:
 
     if args.pipeline:
         value = run_pipeline(args.batch, max(args.steps, 20))
-        name = f"host_pipeline_b{args.batch}"
+        # no dtype component: the pipeline moves uint8 regardless of --dtype,
+        # and the round-over-round series must not fragment on an unused flag
+        metric = f"host_pipeline_b{args.batch}_{platform}"
     elif args.config is not None:
         models, batch = CONFIGS[args.config]
         batch = min(batch, args.batch) if platform == "cpu" else batch
@@ -187,10 +189,12 @@ def main() -> int:
         )
         name = f"train_throughput_{args.model}_b{args.batch}"
 
+    if not args.pipeline:
+        metric = f"{name}_{args.dtype}_{platform}"
     print(
         json.dumps(
             {
-                "metric": f"{name}_{args.dtype}_{platform}",
+                "metric": metric,
                 "value": round(value, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": 1.0,

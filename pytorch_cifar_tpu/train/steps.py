@@ -63,8 +63,15 @@ def make_train_step(
     std: Sequence[float] = CIFAR10_STD,
     compute_dtype=jnp.float32,
     axis_name: Optional[str] = None,
+    remat: bool = False,
 ) -> Callable:
-    """Returns step(state, batch=(uint8 images, labels), rng) -> (state, metrics)."""
+    """Returns step(state, batch=(uint8 images, labels), rng) -> (state, metrics).
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint``: activations are
+    recomputed during backward instead of stored, trading FLOPs for HBM —
+    the lever for batch sizes whose activation footprint exceeds chip
+    memory (no reference equivalent; torch's is torch.utils.checkpoint).
+    """
 
     def step(state: TrainState, batch, rng) -> Tuple[TrainState, Metrics]:
         images, labels = batch
@@ -80,13 +87,18 @@ def make_train_step(
         else:
             x = normalize(images, mean, std, dtype=compute_dtype)
 
-        def loss_fn(params):
+        def fwd(params, x, key):
             variables = {"params": params, "batch_stats": state.batch_stats}
-            out = state.apply_fn(
+            return state.apply_fn(
                 variables, x, train=True, mutable=["batch_stats"],
                 rngs={"stochastic": key},
             )
-            logits, mutated = out
+
+        if remat:
+            fwd = jax.checkpoint(fwd)
+
+        def loss_fn(params):
+            logits, mutated = fwd(params, x, key)
             loss = cross_entropy(logits, labels)
             return loss, (logits, mutated.get("batch_stats", state.batch_stats))
 

@@ -166,6 +166,7 @@ class Trainer:
                     std=config.std,
                     compute_dtype=compute,
                     axis_name=DATA_AXIS,
+                    remat=config.remat,
                 ),
                 self.mesh,
             )

@@ -74,3 +74,30 @@ def test_eval_deterministic():
     m1 = estep(state, (x, y))
     m2 = estep(state, (x, y))
     assert float(m1["loss_sum"]) == float(m2["loss_sum"])
+
+
+def test_remat_matches_plain_step():
+    """jax.checkpoint rematerialization must not change the math: one step
+    with remat on/off from identical state produces identical params (same
+    ops, only the backward's memory/recompute schedule differs)."""
+    model = create_model("ResNet18")
+    tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=4)
+    rs = np.random.RandomState(0)
+    batch = (
+        rs.randint(0, 256, size=(8, 32, 32, 3), dtype=np.uint8),
+        rs.randint(0, 10, size=(8,)).astype(np.int32),
+    )
+    rng = jax.random.PRNGKey(3)
+
+    results = []
+    for remat in (False, True):
+        state = create_train_state(model, jax.random.PRNGKey(0), tx)
+        step = jax.jit(make_train_step(remat=remat))
+        state, metrics = step(state, batch, rng)
+        results.append(
+            (float(metrics["loss_sum"]), jax.device_get(state.params))
+        )
+    assert results[0][0] == results[1][0]
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, results[0][1], results[1][1]
+    )

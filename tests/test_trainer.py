@@ -184,7 +184,9 @@ def test_stale_preemption_save_not_preferred(tmp_path):
     assert tr2.start_epoch == 6
     assert tr2.best_acc == 50.0
 
-    # tie (same epoch) -> the preemption save wins (exact latest opt state)
-    save_checkpoint(cfg.output_dir, tr.state, 5, 50.0, name=LAST_NAME)
+    # tie (same epoch) -> the preemption save wins (exact latest opt state);
+    # distinguishable best_acc proves which file was actually restored
+    save_checkpoint(cfg.output_dir, tr.state, 5, 51.0, name=LAST_NAME)
     tr3 = Trainer(small_config(tmp_path, epochs=9, resume=True))
     assert tr3.start_epoch == 6
+    assert tr3.best_acc == 51.0
