@@ -134,9 +134,10 @@ def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
 
 
 def main() -> int:
-    from pytorch_cifar_tpu import honor_platform_env
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
 
     honor_platform_env()
+    enable_compilation_cache()
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default="ResNet18")
     parser.add_argument("--batch", type=int, default=512)

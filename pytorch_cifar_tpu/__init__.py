@@ -35,3 +35,22 @@ def honor_platform_env() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compilation_cache(path: str = "/tmp/pytorch_cifar_tpu_jax_cache") -> None:
+    """Persist XLA compilations across processes.
+
+    TPU compiles of the fused train step are expensive (measured on the
+    tunneled v5e: ~40 s for ResNet-18, ~200 s for LeNet — small models are
+    not fast to *compile*), and every CLI invocation is a fresh process. The
+    on-disk cache turns every repeat compile into a ~1 s deserialization.
+    Entry points (train.py, bench.py, tools/) call this; tests do not (CPU
+    compiles are fast, and cache writes would race under pytest-xdist).
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache everything: the default min-entry-size skips small programs,
+    # but on this platform even tiny-model steps take minutes to compile
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -53,6 +53,11 @@ class TrainConfig:
     # parallelism
     num_devices: int = 0  # 0 = all local devices, data-parallel mesh
     distributed: bool = False  # multi-host: jax.distributed.initialize()
+    # cross-replica BatchNorm: pmean batch moments over the data axis so
+    # normalization uses global-batch statistics. Default off = the
+    # reference's per-replica BN under DDP (SURVEY.md §7.2; no SyncBN
+    # anywhere in the reference tree)
+    sync_bn: bool = False
 
     # checkpointing (reference: main.py:136-148)
     output_dir: str = "./checkpoint"

@@ -74,28 +74,38 @@ def crop_flip_onehot(
     the flip folded into B by reversing the output index) — two tiny batched
     einsums that ride the MXU. Bit-identical to random_crop+random_hflip
     under the same key (tests/test_data.py), ~8x faster.
+
+    The einsums run in bf16, which is still EXACT: bf16 represents every
+    integer 0..256, each selection row is one-hot so every output element is
+    a single selected uint8 value (no accumulation), and the MXU accumulates
+    in fp32 regardless. Measured 4x faster than the fp32 einsums on v5e
+    (fp32 matmul is emulated by multiple bf16 MXU passes).
     """
     n, h, w, c = x.shape
     kc, kf = jax.random.split(key)
     offs = jax.random.randint(kc, (n, 2), 0, 2 * padding + 1)
     xp = jnp.pad(
         x, [(0, 0), (padding, padding), (padding, padding), (0, 0)]
-    ).astype(jnp.float32)
+    ).astype(jnp.bfloat16)
     hp, wp = h + 2 * padding, w + 2 * padding
 
     rows = jax.lax.broadcasted_iota(jnp.int32, (n, h, hp), 1)
     src_r = jax.lax.broadcasted_iota(jnp.int32, (n, h, hp), 2)
-    sel_rows = (src_r == rows + offs[:, 0, None, None]).astype(jnp.float32)
+    sel_rows = (src_r == rows + offs[:, 0, None, None]).astype(jnp.bfloat16)
 
     cols = jax.lax.broadcasted_iota(jnp.int32, (n, w, wp), 1)
     if flip:
         do_flip = jax.random.bernoulli(kf, 0.5, (n,))[:, None, None]
         cols = jnp.where(do_flip, w - 1 - cols, cols)
     src_c = jax.lax.broadcasted_iota(jnp.int32, (n, w, wp), 2)
-    sel_cols = (src_c == cols + offs[:, 1, None, None]).astype(jnp.float32)
+    sel_cols = (src_c == cols + offs[:, 1, None, None]).astype(jnp.bfloat16)
 
-    out = jnp.einsum("nhH,nHWc->nhWc", sel_rows, xp)
-    return jnp.einsum("nwW,nhWc->nhwc", sel_cols, out)
+    out = jnp.einsum(
+        "nhH,nHWc->nhWc", sel_rows, xp, preferred_element_type=jnp.float32
+    ).astype(jnp.bfloat16)
+    return jnp.einsum(
+        "nwW,nhWc->nhwc", sel_cols, out, preferred_element_type=jnp.float32
+    )
 
 
 def augment_batch(

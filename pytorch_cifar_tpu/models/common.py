@@ -28,6 +28,38 @@ from flax import linen as nn
 Dtype = Any
 
 # ---------------------------------------------------------------------------
+# Cross-replica (Sync) BatchNorm context
+# ---------------------------------------------------------------------------
+#
+# The reference has no SyncBN anywhere — under DDP each rank normalizes its
+# local shard (SURVEY.md §7.2), and that stays our default for parity. This
+# context enables the cross-replica extension the survey anticipates: inside
+# ``with sync_batchnorm(axis)``, every BatchNorm in the traced model pmeans
+# its batch moments over the mesh axis, so normalization uses GLOBAL batch
+# statistics (equivalent to single-device BN over the full global batch).
+# A trace-time context instead of a module attribute so none of the 19 model
+# files change; the flag is baked into the jitted step at trace time
+# (make_train_step(sync_bn=True)).
+
+import contextlib
+import contextvars
+
+_SYNC_BN_AXIS: contextvars.ContextVar = contextvars.ContextVar(
+    "sync_bn_axis", default=None
+)
+
+
+@contextlib.contextmanager
+def sync_batchnorm(axis_name: Optional[str]):
+    """Trace-time context: BatchNorms psum batch moments over ``axis_name``."""
+    token = _SYNC_BN_AXIS.set(axis_name)
+    try:
+        yield
+    finally:
+        _SYNC_BN_AXIS.reset(token)
+
+
+# ---------------------------------------------------------------------------
 # PyTorch-default initializers
 # ---------------------------------------------------------------------------
 
@@ -175,19 +207,27 @@ class BatchNorm(nn.Module):
             axes = tuple(range(x.ndim - 1))
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=axes)
+            sq = jnp.mean(jnp.square(xf), axis=axes)
+            world = 1
+            sync_axis = _SYNC_BN_AXIS.get()
+            if sync_axis is not None and not self.is_initializing():
+                # cross-replica moments: with equal shard sizes the pmean of
+                # per-shard E[x], E[x^2] is exactly the global moments
+                mean = jax.lax.pmean(mean, sync_axis)
+                sq = jax.lax.pmean(sq, sync_axis)
+                world = jax.lax.psum(1, sync_axis)
             # one-pass biased variance normalizes the batch (torch
             # F.batch_norm); E[x^2]-E[x]^2 keeps it a single fused reduction
             # clamp: catastrophic cancellation can push the one-pass result
             # a hair negative for high-mean/low-var channels, and rsqrt of
             # (negative + eps) would NaN the step
-            var = jnp.maximum(
-                jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0
-            )
+            var = jnp.maximum(sq - jnp.square(mean), 0.0)
             if not self.is_initializing():
                 n = 1
                 for d in axes:
                     n *= x.shape[d]
-                unbiased = var * (n / max(n - 1, 1))
+                n = n * world  # global sample count under SyncBN
+                unbiased = var * (n / jnp.maximum(n - 1, 1))
                 m = self.momentum
                 ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
                 ra_var.value = (1.0 - m) * ra_var.value + m * unbiased

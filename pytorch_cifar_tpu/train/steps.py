@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 
 from pytorch_cifar_tpu.data.augment import CIFAR10_MEAN, CIFAR10_STD, augment_batch, normalize
+from pytorch_cifar_tpu.models.common import sync_batchnorm
 from pytorch_cifar_tpu.train.state import TrainState
 
 Metrics = dict
@@ -64,6 +65,7 @@ def make_train_step(
     compute_dtype=jnp.float32,
     axis_name: Optional[str] = None,
     remat: bool = False,
+    sync_bn: bool = False,
 ) -> Callable:
     """Returns step(state, batch=(uint8 images, labels), rng) -> (state, metrics).
 
@@ -71,7 +73,15 @@ def make_train_step(
     recomputed during backward instead of stored, trading FLOPs for HBM —
     the lever for batch sizes whose activation footprint exceeds chip
     memory (no reference equivalent; torch's is torch.utils.checkpoint).
+
+    ``sync_bn=True`` (requires ``axis_name``) switches every BatchNorm to
+    cross-replica statistics: batch moments are pmean'd over the mesh axis,
+    so normalization matches single-device BN over the global batch. The
+    default (False) matches the reference's per-replica BN under DDP
+    (SURVEY.md §7.2).
     """
+    if sync_bn and axis_name is None:
+        raise ValueError("sync_bn requires a data-parallel axis_name")
 
     def step(state: TrainState, batch, rng) -> Tuple[TrainState, Metrics]:
         images, labels = batch
@@ -89,10 +99,11 @@ def make_train_step(
 
         def fwd(params, x, key):
             variables = {"params": params, "batch_stats": state.batch_stats}
-            return state.apply_fn(
-                variables, x, train=True, mutable=["batch_stats"],
-                rngs={"stochastic": key},
-            )
+            with sync_batchnorm(axis_name if sync_bn else None):
+                return state.apply_fn(
+                    variables, x, train=True, mutable=["batch_stats"],
+                    rngs={"stochastic": key},
+                )
 
         if remat:
             fwd = jax.checkpoint(fwd)
@@ -109,7 +120,8 @@ def make_train_step(
         metrics = _metrics(logits, labels)
         if axis_name is not None:
             grads = jax.lax.pmean(grads, axis_name)
-            new_stats = jax.lax.pmean(new_stats, axis_name)
+            if not sync_bn:  # under sync_bn stats are already replica-identical
+                new_stats = jax.lax.pmean(new_stats, axis_name)
             metrics = jax.tree_util.tree_map(
                 lambda m: jax.lax.psum(m, axis_name), metrics
             )

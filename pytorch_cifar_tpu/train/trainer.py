@@ -167,6 +167,7 @@ class Trainer:
                     compute_dtype=compute,
                     axis_name=DATA_AXIS,
                     remat=config.remat,
+                    sync_bn=config.sync_bn,
                 ),
                 self.mesh,
             )

@@ -134,6 +134,54 @@ def test_augmentation_decorrelated_across_shards():
     assert any(diffs), "all shards produced identical augmentations"
 
 
+def test_sync_bn_matches_global_batch_stats():
+    """--sync_bn: 8-shard BN with pmean'd moments == single-device BN over
+    the full global batch (the cross-replica extension of SURVEY.md §7.2;
+    default per-replica BN is covered by test_dp_matches_single_device_*
+    only at shard-invariant models — LeNet has no BN)."""
+    x, y = make_batch(32, seed=7)
+
+    # single device, full batch: plain BN already sees the global batch
+    state1 = make_state("ResNet18", seed=2)
+    step1 = jax.jit(make_train_step(augment=False))
+    state1, m1 = step1(
+        state1, (jnp.asarray(x), jnp.asarray(y)), jax.random.PRNGKey(0)
+    )
+
+    # 8-way DP with sync_bn: moments pmean'd back to global
+    mesh = make_mesh()
+    state8 = replicate(make_state("ResNet18", seed=2), mesh)
+    sh = batch_sharding(mesh)
+    step8 = data_parallel_train_step(
+        make_train_step(augment=False, axis_name=DATA_AXIS, sync_bn=True), mesh
+    )
+    state8, m8 = step8(
+        state8, (jax.device_put(x, sh), jax.device_put(y, sh)),
+        jax.random.PRNGKey(0),
+    )
+
+    np.testing.assert_allclose(
+        float(m1["loss_sum"]), float(m8["loss_sum"]), rtol=1e-4
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state1.batch_stats),
+        jax.tree_util.tree_leaves(jax.device_get(state8.batch_stats)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+    # sharded-vs-single reductions reassociate fp32 sums; the update is
+    # statistically identical, not bit-identical (lr amplifies grad noise)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state1.params),
+        jax.tree_util.tree_leaves(jax.device_get(state8.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_sync_bn_requires_axis():
+    with pytest.raises(ValueError):
+        make_train_step(sync_bn=True)
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__
 

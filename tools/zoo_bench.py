@@ -1,0 +1,99 @@
+"""Zoo-wide TPU throughput sweep: one line per model, images/sec/chip.
+
+Runs the same jitted train iteration as ``bench.py`` (on-device augmentation,
+bf16 forward/backward, SGD update) for every requested registry model and
+prints a sorted table plus a JSON artifact. This is the measurement tool for
+SURVEY.md §7 hard part #3 — finding which architectures (depthwise/grouped
+convs, concat-heavy graphs) fall off the MXU fast path — so optimization
+effort goes where the numbers say.
+
+Usage:
+  python tools/zoo_bench.py                    # one representative per family
+  python tools/zoo_bench.py --all              # all registry entries
+  python tools/zoo_bench.py --models ResNet18 DPN92 --batch 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# one representative per reference module (SURVEY.md §2.2's 17 families)
+FAMILY_REPS = [
+    "LeNet", "VGG19", "ResNet18", "PreActResNet18", "SENet18",
+    "GoogLeNet", "DenseNet121", "ResNeXt29_32x4d", "MobileNet",
+    "MobileNetV2", "EfficientNetB0", "RegNetX_200MF", "DPN92",
+    "ShuffleNetG2", "ShuffleNetV2_1x", "PNASNetA", "SimpleDLA", "DLA",
+]
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+    import jax
+
+    from bench import run_one
+    from pytorch_cifar_tpu.models import available_models
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--models", nargs="*", default=None)
+    parser.add_argument("--all", action="store_true")
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--warmup", type=int, default=10)
+    parser.add_argument("--out", default=None, help="write JSON results here")
+    args = parser.parse_args()
+
+    if args.models:
+        names = args.models
+    elif args.all:
+        names = list(available_models())
+    else:
+        names = FAMILY_REPS
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # local smoke only (mirrors bench.py's clamp): cap, never raise
+        args.batch = min(args.batch, 64)
+        args.steps = min(args.steps, 3)
+        args.warmup = min(args.warmup, 1)
+
+    import jax.numpy as jnp
+
+    results = {}
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            rate = run_one(name, args.batch, args.steps, args.warmup, jnp.bfloat16)
+        except Exception as e:  # keep sweeping past a single bad model
+            print(f"{name:20s} FAILED: {type(e).__name__}: {e}", flush=True)
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        wall = time.perf_counter() - t0
+        results[name] = {"images_per_sec": round(rate, 1), "batch": args.batch}
+        print(
+            f"{name:20s} {rate:10.0f} img/s  "
+            f"({args.batch * 1000 / rate:6.2f} ms/step, sweep {wall:.0f}s)",
+            flush=True,
+        )
+
+    ok = {k: v for k, v in results.items() if "error" not in v}
+    if ok:
+        ranked = sorted(ok, key=lambda k: ok[k]["images_per_sec"])
+        print("\nslowest five:", ", ".join(ranked[:5]))
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps({"platform": platform, "results": results}, indent=1)
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

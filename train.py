@@ -8,12 +8,13 @@ Examples:
     python train.py --synthetic_data --epochs 2     # no-dataset smoke run
 """
 
-from pytorch_cifar_tpu import honor_platform_env
+from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
 from pytorch_cifar_tpu.config import parse_config
 
 
 def main(argv=None) -> float:
     honor_platform_env()
+    enable_compilation_cache()
     from pytorch_cifar_tpu.train.trainer import Trainer
 
     config = parse_config(argv)
