@@ -1,0 +1,88 @@
+"""Per-model train-step cost analysis: XLA FLOPs, bytes, and MXU utilization.
+
+Compiles the exact bench train step for each model, reads XLA's
+``cost_analysis()`` (compiler-counted FLOPs and HBM traffic), measures the
+steady-state step time, and reports achieved FLOP/s and utilization against
+the chip peak. This separates "the model is big" from "the model maps badly
+onto the MXU" — the distinction that decides where kernel work goes
+(SURVEY.md §7 hard part #3).
+
+Usage: python tools/step_cost.py --models GoogLeNet ResNet18 [--batch 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# bf16 peak FLOP/s per chip; v5e (v5 lite) ~197 TFLOP/s, v4 ~275
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import build_step
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--models", nargs="+", default=["ResNet18"])
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--steps", type=int, default=30)
+    args = parser.parse_args()
+
+    from bench import clamp_for_cpu
+
+    platform = clamp_for_cpu(args)
+    peak = PEAK_FLOPS.get(platform, 197e12)
+
+    rs = np.random.RandomState(0)
+    img = jax.device_put(
+        rs.randint(0, 256, size=(args.batch, 32, 32, 3), dtype=np.uint8)
+    )
+    lab = jax.device_put(rs.randint(0, 10, size=(args.batch,)).astype(np.int32))
+    rng = jax.random.PRNGKey(42)
+
+    print(
+        f"{'model':20s} {'GFLOP/step':>11s} {'GB/step':>8s} {'ms':>7s} "
+        f"{'TFLOP/s':>8s} {'util':>6s} {'img/s':>8s}"
+    )
+    for name in args.models:
+        state, step = build_step(name, args.batch, jnp.bfloat16)
+        compiled = step.lower(state, (img, lab), rng).compile()
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, list) else (cost or {})
+        flops = float(cost.get("flops", 0.0))
+        nbytes = float(cost.get("bytes accessed", 0.0))
+
+        # steady state: chain through donated state, sync via metric fetch.
+        # NB: time the jitted wrapper, not `compiled` — the AOT object
+        # rejects the dict/FrozenDict pytree drift the wrapper normalizes.
+        state, metrics = step(state, (img, lab), rng)
+        float(metrics["loss_sum"])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, metrics = step(state, (img, lab), rng)
+        float(metrics["loss_sum"])
+        dt = (time.perf_counter() - t0) / args.steps
+
+        achieved = flops / dt
+        print(
+            f"{name:20s} {flops/1e9:11.1f} {nbytes/1e9:8.2f} {dt*1e3:7.2f} "
+            f"{achieved/1e12:8.1f} {achieved/peak*100:5.1f}% "
+            f"{args.batch/dt:8.0f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

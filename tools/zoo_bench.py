@@ -28,7 +28,7 @@ FAMILY_REPS = [
     "LeNet", "VGG19", "ResNet18", "PreActResNet18", "SENet18",
     "GoogLeNet", "DenseNet121", "ResNeXt29_32x4d", "MobileNet",
     "MobileNetV2", "EfficientNetB0", "RegNetX_200MF", "DPN92",
-    "ShuffleNetG2", "ShuffleNetV2_1x", "PNASNetA", "SimpleDLA", "DLA",
+    "ShuffleNetG2", "ShuffleNetV2_1", "PNASNetA", "SimpleDLA", "DLA",
 ]
 
 
@@ -58,16 +58,22 @@ def main() -> int:
     else:
         names = FAMILY_REPS
 
-    platform = jax.devices()[0].platform
-    if platform == "cpu":
-        # local smoke only (mirrors bench.py's clamp): cap, never raise
-        args.batch = min(args.batch, 64)
-        args.steps = min(args.steps, 3)
-        args.warmup = min(args.warmup, 1)
+    from bench import clamp_for_cpu
+
+    platform = clamp_for_cpu(args)
 
     import jax.numpy as jnp
 
     results = {}
+
+    def flush_out():
+        # incremental: a tunnel drop at model 25 of an --all sweep must not
+        # discard the hours of numbers already collected
+        if args.out:
+            Path(args.out).write_text(
+                json.dumps({"platform": platform, "results": results}, indent=1)
+            )
+
     for name in names:
         t0 = time.perf_counter()
         try:
@@ -75,6 +81,7 @@ def main() -> int:
         except Exception as e:  # keep sweeping past a single bad model
             print(f"{name:20s} FAILED: {type(e).__name__}: {e}", flush=True)
             results[name] = {"error": f"{type(e).__name__}: {e}"}
+            flush_out()
             continue
         wall = time.perf_counter() - t0
         results[name] = {"images_per_sec": round(rate, 1), "batch": args.batch}
@@ -83,15 +90,12 @@ def main() -> int:
             f"({args.batch * 1000 / rate:6.2f} ms/step, sweep {wall:.0f}s)",
             flush=True,
         )
+        flush_out()
 
     ok = {k: v for k, v in results.items() if "error" not in v}
     if ok:
         ranked = sorted(ok, key=lambda k: ok[k]["images_per_sec"])
         print("\nslowest five:", ", ".join(ranked[:5]))
-    if args.out:
-        Path(args.out).write_text(
-            json.dumps({"platform": platform, "results": results}, indent=1)
-        )
     return 0
 
 
