@@ -13,6 +13,13 @@ Sharding semantics match the reference's ``global batch / world_size``
 axis by NamedSharding, so each device reads batch/n_devices images. The
 per-epoch reshuffle is seeded with (seed, epoch) — the determinism the
 reference loses by never calling ``sampler.set_epoch`` (SURVEY.md §3.2).
+
+Multi-host: every process computes the same epoch permutation (seed is
+part of the config, shared by all hosts), gathers only its own contiguous
+slice of each global batch (the DistributedSampler role,
+main_dist.py:110), and assembles the global array from process-local
+shards via ``jax.make_array_from_process_local_data`` — a plain
+``device_put`` against a global sharding only works single-process.
 """
 
 from __future__ import annotations
@@ -85,9 +92,20 @@ class Dataloader:
             (self.seed * 9973 + epoch * 31 + 7) % (2**31)
         )
 
+        # multi-host: this process materializes only its slice of each
+        # global batch; rows [pid*B/P, (pid+1)*B/P) of the shared permutation
+        pid, pcount = jax.process_index(), jax.process_count()
+        local_bs = self.batch_size // pcount if pcount > 1 else self.batch_size
+        if pcount > 1 and self.batch_size % pcount:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"{pcount} processes"
+            )
+
         def host_batches():
             for b in range(nb):
-                idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+                lo = b * self.batch_size + pid * local_bs
+                idx = order[lo : lo + local_bs]
                 # native parallel gather (OpenMP memcpy, GIL released) with a
                 # numpy fancy-indexing fallback — native/cifar_native.cpp
                 x, y = gather_batch(self.images, self.labels, idx)
@@ -100,9 +118,15 @@ class Dataloader:
                         aug_rng.randint(0, 2 if self.augment_flip else 1, n),
                         padding=pad,
                     )
-                if not self.drop_last and x.shape[0] < self.batch_size:
-                    pad = self.batch_size - x.shape[0]
-                    x = np.concatenate([x, np.zeros_like(x[:1]).repeat(pad, 0)])
+                if not self.drop_last and x.shape[0] < local_bs:
+                    # every process pads its slice to exactly local_bs so
+                    # shard shapes stay consistent across processes on the
+                    # ragged final batch (a process's slice can even be
+                    # empty); -1 labels are masked out of the metrics
+                    pad = local_bs - x.shape[0]
+                    x = np.concatenate(
+                        [x, np.zeros((pad,) + x.shape[1:], x.dtype)]
+                    )
                     y = np.concatenate([y, np.full((pad,), -1, y.dtype)])
                 yield x, y
 
@@ -120,13 +144,51 @@ class Dataloader:
                 yield queue.popleft()
 
     def _put(self, x: np.ndarray, y: np.ndarray):
-        if self.sharding is not None:
+        if jax.process_count() > 1:
+            if self.sharding is None:
+                raise ValueError(
+                    "multi-process Dataloader requires a batch sharding"
+                )
+            # assemble the global array from this process's local shard
+            x = jax.make_array_from_process_local_data(self.sharding, x)
+            y = jax.make_array_from_process_local_data(self.sharding, y)
+        elif self.sharding is not None:
             x = jax.device_put(x, self.sharding)
             y = jax.device_put(y, self.sharding)
         else:
             x = jax.device_put(x)
             y = jax.device_put(y)
         return x, y
+
+
+def put_global(
+    x: np.ndarray, y: np.ndarray, sharding: Optional[jax.sharding.Sharding]
+):
+    """Place a host-materialized GLOBAL batch onto the mesh.
+
+    Single-process: a plain sharded device_put. Multi-process: every process
+    holds the same global batch (e.g. the full test set, eval_batches);
+    each contributes only its contiguous slice and the global array is
+    assembled from process-local shards.
+    """
+    if jax.process_count() > 1:
+        if sharding is None:
+            raise ValueError("multi-process put_global requires a sharding")
+        pid, pcount = jax.process_index(), jax.process_count()
+        if x.shape[0] % pcount:
+            raise ValueError(
+                f"global batch {x.shape[0]} not divisible by {pcount} processes"
+            )
+        lb = x.shape[0] // pcount
+        xl = x[pid * lb : (pid + 1) * lb]
+        yl = y[pid * lb : (pid + 1) * lb]
+        return (
+            jax.make_array_from_process_local_data(sharding, xl),
+            jax.make_array_from_process_local_data(sharding, yl),
+        )
+    if sharding is not None:
+        return jax.device_put(x, sharding), jax.device_put(y, sharding)
+    return jax.device_put(x), jax.device_put(y)
 
 
 def eval_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):

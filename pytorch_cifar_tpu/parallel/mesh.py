@@ -31,9 +31,14 @@ def initialize_distributed(
     the explicit arguments exist for CPU/GPU multi-process testing. Safe to
     call in single-process runs (no-op if already initialized or
     single-host).
+
+    NB: the already-initialized check must NOT touch ``jax.process_count()``
+    or ``jax.devices()`` — those force backend initialization, after which
+    ``jax.distributed.initialize`` is permanently too late (the process
+    would silently run single-host with its local devices only).
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    if jax.distributed.is_initialized():
+        return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -41,7 +46,13 @@ def initialize_distributed(
             process_id=process_id,
         )
     except (ValueError, RuntimeError):
-        # single-host / already-initialized: SPMD code below works unchanged
+        if coordinator_address is not None:
+            # an explicitly requested rendezvous that fails must be loud:
+            # swallowing it would silently degrade the job to independent
+            # single-host runs with wrong global-batch semantics
+            raise
+        # auto-detect on a single host: SPMD code below works unchanged on
+        # the local devices
         pass
 
 

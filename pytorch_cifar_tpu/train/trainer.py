@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from pytorch_cifar_tpu.config import TrainConfig
 from pytorch_cifar_tpu.data.cifar10 import load_cifar10, synthetic_cifar10
-from pytorch_cifar_tpu.data.pipeline import Dataloader, eval_batches
+from pytorch_cifar_tpu.data.pipeline import Dataloader, eval_batches, put_global
 from pytorch_cifar_tpu.models import create_model
 from pytorch_cifar_tpu.parallel import (
     DATA_AXIS,
@@ -281,10 +281,7 @@ class Trainer:
         for x, y in eval_batches(
             self.test_images, self.test_labels, self.eval_bs
         ):
-            batch = (
-                jax.device_put(x, self.sharding),
-                jax.device_put(y, self.sharding),
-            )
+            batch = put_global(x, y, self.sharding)
             m = jax.device_get(self.eval_step(self.state, batch))
             loss_sum += float(m["loss_sum"])
             correct += float(m["correct"])

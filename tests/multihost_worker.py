@@ -1,0 +1,124 @@
+"""Worker for tests/test_multihost.py: one process of an N-process SPMD job.
+
+Runs the REAL multi-process path — ``jax.distributed.initialize`` over a
+localhost coordinator, a global mesh spanning both processes' devices,
+process-local batch assembly, psum'd metrics, process-0-only checkpointing
+with broadcast restore — on CPU devices. This is the rendezvous topology the
+reference needed a live NCCL cluster to exercise (main_dist.py:51-82);
+here it runs inside CI.
+
+Usage: multihost_worker.py <pid> <nproc> <port>  (nproc=1: single-process
+comparator producing the same global computation on one process.)
+
+Prints one JSON line: {"loss": ..., "count": ..., "psum": ..., "resumed_epoch": ...}
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    out_dir = sys.argv[4]
+
+    from pytorch_cifar_tpu import honor_platform_env
+    from pytorch_cifar_tpu.parallel.mesh import initialize_distributed
+
+    # BEFORE any backend-initializing jax call: pin the cpu platform at the
+    # config level (the site TPU plugin overrides the env var and would
+    # otherwise seize the real chip), and pick a cross-process CPU
+    # collectives implementation — without one the CPU client silently
+    # comes up single-process (process_count()==1).
+    honor_platform_env()
+    if nproc > 1:
+        import jax
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        initialize_distributed(f"localhost:{port}", nproc, pid)
+
+    import jax
+    import numpy as np
+
+    from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
+    from pytorch_cifar_tpu.data.pipeline import Dataloader, put_global
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.parallel import (
+        DATA_AXIS,
+        batch_sharding,
+        data_parallel_eval_step,
+        data_parallel_train_step,
+        make_mesh,
+        replicate,
+    )
+    from pytorch_cifar_tpu.train.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+    from pytorch_cifar_tpu.train.steps import make_eval_step, make_train_step
+
+    assert jax.process_count() == nproc, (jax.process_count(), nproc)
+    assert jax.device_count() == 8, jax.device_count()
+
+    mesh = make_mesh()  # all 8 global devices, both topologies
+    sharding = batch_sharding(mesh)
+
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.05, t_max=4, steps_per_epoch=4)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    state = replicate(state, mesh)
+
+    tr_x, tr_y, te_x, te_y = synthetic_cifar10(n_train=256, n_test=64)
+    loader = Dataloader(tr_x, tr_y, batch_size=64, seed=3, sharding=sharding)
+    train_step = data_parallel_train_step(
+        make_train_step(axis_name=DATA_AXIS), mesh
+    )
+    eval_step = data_parallel_eval_step(make_eval_step(axis_name=DATA_AXIS), mesh)
+
+    rng = jax.random.PRNGKey(1)
+    metrics = None
+    for epoch in range(2):
+        for batch in loader.epoch(epoch):
+            state, metrics = train_step(state, batch, rng)
+    m = jax.device_get(metrics)
+    loss = float(m["loss_sum"]) / float(m["count"])
+
+    # eval over a global batch materialized on every process
+    ev = jax.device_get(eval_step(state, put_global(te_x, te_y, sharding)))
+
+    # checkpoint round-trip across the process boundary: process 0 writes,
+    # every process restores via broadcast
+    save_checkpoint(out_dir, state, epoch=1, best_acc=12.5)
+    state2, start_epoch, best_acc = restore_checkpoint(out_dir, state)
+    assert start_epoch == 2 and abs(best_acc - 12.5) < 1e-6
+
+    # param checksum over the restored replicated state (same on every host)
+    psum = float(
+        sum(
+            np.abs(np.asarray(jax.device_get(p), np.float64)).sum()
+            for p in jax.tree_util.tree_leaves(state2.params)
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "loss": loss,
+                "count": float(m["count"]),
+                "eval_count": float(ev["count"]),
+                "psum": psum,
+                "resumed_epoch": start_epoch,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
