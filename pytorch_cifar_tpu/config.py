@@ -58,6 +58,11 @@ class TrainConfig:
     # reference's per-replica BN under DDP (SURVEY.md §7.2; no SyncBN
     # anywhere in the reference tree)
     sync_bn: bool = False
+    # spatial partitioning (parallel/spatial.py): shard image height over a
+    # second mesh axis of this size; GSPMD inserts conv halo exchanges and
+    # cross-shard BN reductions. 1 = pure data parallel (reference scope).
+    # The vision analogue of sequence/context parallelism.
+    spatial_devices: int = 1
 
     # checkpointing (reference: main.py:136-148)
     output_dir: str = "./checkpoint"

@@ -45,6 +45,7 @@ class Dataloader:
         drop_last: bool = True,
         seed: int = 0,
         sharding: Optional[jax.sharding.Sharding] = None,
+        label_sharding: Optional[jax.sharding.Sharding] = None,
         prefetch: int = 2,
         host_augment: bool = False,
         augment_padding: int = 4,
@@ -59,6 +60,10 @@ class Dataloader:
             labels, np.int32 if labels.dtype.kind in "iu" else labels.dtype
         )
         self.batch_size = batch_size
+        # images and labels usually share one batch-axis sharding; spatial
+        # partitioning shards images (N,H,...) on two axes while labels (N,)
+        # stay batch-only — pass both then
+        self.label_sharding = label_sharding if label_sharding is not None else sharding
         self.shuffle = shuffle
         # Like the reference's drop_last=False default, a ragged final batch
         # would retrigger XLA compilation per distinct shape; on TPU we drop
@@ -110,14 +115,19 @@ class Dataloader:
                 # numpy fancy-indexing fallback — native/cifar_native.cpp
                 x, y = gather_batch(self.images, self.labels, idx)
                 if self.host_augment:
-                    n, pad = x.shape[0], self.augment_padding
-                    x = augment_batch_u8(
-                        x,
-                        aug_rng.randint(0, 2 * pad + 1, n),
-                        aug_rng.randint(0, 2 * pad + 1, n),
-                        aug_rng.randint(0, 2 if self.augment_flip else 1, n),
-                        padding=pad,
-                    )
+                    pad = self.augment_padding
+                    # draw for the FULL global batch and slice this
+                    # process's rows: every process consumes the same
+                    # stream, so augmentation stays decorrelated across
+                    # shards and topology-invariant vs single-process
+                    n = x.shape[0]
+                    s = slice(pid * local_bs, pid * local_bs + n)
+                    dx = aug_rng.randint(0, 2 * pad + 1, self.batch_size)[s]
+                    dy = aug_rng.randint(0, 2 * pad + 1, self.batch_size)[s]
+                    fl = aug_rng.randint(
+                        0, 2 if self.augment_flip else 1, self.batch_size
+                    )[s]
+                    x = augment_batch_u8(x, dx, dy, fl, padding=pad)
                 if not self.drop_last and x.shape[0] < local_bs:
                     # every process pads its slice to exactly local_bs so
                     # shard shapes stay consistent across processes on the
@@ -151,10 +161,10 @@ class Dataloader:
                 )
             # assemble the global array from this process's local shard
             x = jax.make_array_from_process_local_data(self.sharding, x)
-            y = jax.make_array_from_process_local_data(self.sharding, y)
+            y = jax.make_array_from_process_local_data(self.label_sharding, y)
         elif self.sharding is not None:
             x = jax.device_put(x, self.sharding)
-            y = jax.device_put(y, self.sharding)
+            y = jax.device_put(y, self.label_sharding)
         else:
             x = jax.device_put(x)
             y = jax.device_put(y)
@@ -162,7 +172,10 @@ class Dataloader:
 
 
 def put_global(
-    x: np.ndarray, y: np.ndarray, sharding: Optional[jax.sharding.Sharding]
+    x: np.ndarray,
+    y: np.ndarray,
+    sharding: Optional[jax.sharding.Sharding],
+    label_sharding: Optional[jax.sharding.Sharding] = None,
 ):
     """Place a host-materialized GLOBAL batch onto the mesh.
 
@@ -171,6 +184,8 @@ def put_global(
     each contributes only its contiguous slice and the global array is
     assembled from process-local shards.
     """
+    if label_sharding is None:
+        label_sharding = sharding
     if jax.process_count() > 1:
         if sharding is None:
             raise ValueError("multi-process put_global requires a sharding")
@@ -184,10 +199,10 @@ def put_global(
         yl = y[pid * lb : (pid + 1) * lb]
         return (
             jax.make_array_from_process_local_data(sharding, xl),
-            jax.make_array_from_process_local_data(sharding, yl),
+            jax.make_array_from_process_local_data(label_sharding, yl),
         )
     if sharding is not None:
-        return jax.device_put(x, sharding), jax.device_put(y, sharding)
+        return jax.device_put(x, sharding), jax.device_put(y, label_sharding)
     return jax.device_put(x), jax.device_put(y)
 
 

@@ -17,3 +17,12 @@ from pytorch_cifar_tpu.parallel.dp import (
     replicate,
     unreplicate,
 )
+from pytorch_cifar_tpu.parallel.spatial import (
+    SPATIAL_AXIS,
+    make_2d_mesh,
+    put_spatial,
+    spatial_batch_sharding,
+    spatial_eval_step,
+    spatial_label_sharding,
+    spatial_train_step,
+)

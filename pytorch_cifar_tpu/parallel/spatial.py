@@ -1,0 +1,104 @@
+"""Spatial partitioning: the vision equivalent of sequence/context parallelism.
+
+The reference has no parallelism beyond data-parallel replicas (SURVEY.md
+§2.4) — its workload has no sequence axis to split. The tensor that grows
+with "context" in a CNN is the image plane, and the TPU-native way to split
+it is GSPMD spatial partitioning: lay the batch over a ``data`` mesh axis
+AND the image height over a ``spatial`` mesh axis, annotate the input
+sharding, and let XLA insert the halo exchanges every 3x3 conv needs at
+shard boundaries (the same compiler machinery that inserts ring
+collectives for sharded attention). No model code changes — the same flax
+modules run unmodified.
+
+Contrast with ``dp.py``: the DP path uses ``shard_map`` (per-shard code,
+explicit ``pmean``/``psum``). Here the step stays GLOBAL-semantics
+(``make_train_step(axis_name=None)``) under plain ``jit`` with sharding
+annotations, and the compiler derives every collective: halo exchange for
+convs, cross-shard reductions for BatchNorm batch statistics (i.e. BN is
+globally exact — the SyncBN semantics fall out for free), gradient
+all-reduce. This is the "pick a mesh, annotate shardings, let XLA insert
+collectives" recipe applied one axis further than the reference ever went.
+
+Scaling use: batch 512 CIFAR fits one chip, but the same two-axis mesh is
+the recipe for inputs that do NOT fit a chip's HBM (high-res vision, video)
+— exactly the role ring attention plays for long sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_cifar_tpu.parallel.mesh import DATA_AXIS
+
+SPATIAL_AXIS = "spatial"
+
+
+def make_2d_mesh(
+    data: int = 0,
+    spatial: int = 1,
+    devices=None,
+) -> Mesh:
+    """(data x spatial) mesh. data=0 means "all devices / spatial"."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if spatial < 1 or n % spatial:
+        raise ValueError(f"spatial={spatial} must divide device count {n}")
+    if not data:
+        data = n // spatial
+    if data * spatial > n:
+        raise ValueError(f"{data}x{spatial} mesh exceeds {n} devices")
+    grid = np.asarray(devices[: data * spatial]).reshape(data, spatial)
+    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def spatial_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Images (N,H,W,C): batch over ``data``, height over ``spatial``."""
+    return NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS))
+
+
+def spatial_label_sharding(mesh: Mesh) -> NamedSharding:
+    """Labels (N,): batch axis only (no spatial dim to split)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def spatial_train_step(step_fn: Callable, mesh: Mesh, donate: bool = True):
+    """jit a GLOBAL-semantics train step (built with ``axis_name=None``)
+    over the 2-D mesh. GSPMD partitions every conv spatially and inserts
+    halo exchanges; state stays replicated; metrics come back replicated.
+    """
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step_fn,
+        in_shardings=(
+            replicated,
+            (spatial_batch_sharding(mesh), spatial_label_sharding(mesh)),
+            replicated,
+        ),
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def spatial_eval_step(step_fn: Callable, mesh: Mesh):
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step_fn,
+        in_shardings=(
+            replicated,
+            (spatial_batch_sharding(mesh), spatial_label_sharding(mesh)),
+        ),
+        out_shardings=replicated,
+    )
+
+
+def put_spatial(x, y, mesh: Mesh) -> Tuple[jax.Array, jax.Array]:
+    """Place a host batch onto the 2-D mesh (single-process path)."""
+    return (
+        jax.device_put(x, spatial_batch_sharding(mesh)),
+        jax.device_put(y, spatial_label_sharding(mesh)),
+    )

@@ -28,8 +28,13 @@ from pytorch_cifar_tpu.parallel import (
     data_parallel_eval_step,
     data_parallel_train_step,
     initialize_distributed,
+    make_2d_mesh,
     make_mesh,
     replicate,
+    spatial_batch_sharding,
+    spatial_eval_step,
+    spatial_label_sharding,
+    spatial_train_step,
 )
 from pytorch_cifar_tpu.parallel.mesh import is_primary
 from pytorch_cifar_tpu.train.checkpoint import (
@@ -66,8 +71,26 @@ class Trainer:
         self.test_images, self.test_labels = te_x, te_y
 
         # -- mesh ------------------------------------------------------
-        self.mesh = make_mesh(config.num_devices)
-        n_dev = self.mesh.devices.size
+        self.spatial = max(config.spatial_devices, 1)
+        if self.spatial > 1:
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "spatial partitioning is single-process for now "
+                    "(process-local shard assembly assumes batch-only sharding)"
+                )
+            total = config.num_devices or len(jax.devices())
+            if total % self.spatial:
+                raise ValueError(
+                    f"spatial_devices={self.spatial} must divide the "
+                    f"device count {total}"
+                )
+            self.mesh = make_2d_mesh(
+                data=total // self.spatial, spatial=self.spatial
+            )
+            n_dev = self.mesh.shape[DATA_AXIS]  # batch divides the data axis
+        else:
+            self.mesh = make_mesh(config.num_devices)
+            n_dev = self.mesh.devices.size
         if config.batch_size % n_dev:
             # parity with main_dist.py:112-115's divisibility warning
             log.warning(
@@ -78,7 +101,12 @@ class Trainer:
         self.global_batch = max(config.batch_size // n_dev, 1) * n_dev
         eval_bs = max(config.eval_batch_size // n_dev, 1) * n_dev
 
-        sharding = batch_sharding(self.mesh)
+        if self.spatial > 1:
+            sharding = spatial_batch_sharding(self.mesh)
+            lbl_sharding = spatial_label_sharding(self.mesh)
+        else:
+            sharding = batch_sharding(self.mesh)
+            lbl_sharding = sharding
         # single source of truth for where augmentation runs: host pipeline
         # (native data plane) vs on-device prologue of the train step
         host_aug = config.host_augment and config.random_crop
@@ -96,12 +124,14 @@ class Trainer:
                 shuffle=True,
                 seed=config.seed,
                 sharding=sharding,
+                label_sharding=lbl_sharding,
                 host_augment=host_aug,
                 augment_flip=config.random_flip,
             )
             self.steps_per_epoch = len(self.loader)
         self.eval_bs = eval_bs
         self.sharding = sharding
+        self.label_sharding = lbl_sharding
 
         # -- model/optimizer/state ------------------------------------
         self.model = create_model(
@@ -155,32 +185,35 @@ class Trainer:
         compute = jnp.bfloat16 if config.amp else jnp.float32
         # on-device augmentation unless the host pipeline already did it
         device_augment = not host_aug
+        step_kwargs = dict(
+            crop=config.random_crop and device_augment,
+            flip=config.random_flip and device_augment,
+            mean=config.mean,
+            std=config.std,
+            compute_dtype=compute,
+            remat=config.remat,
+        )
+        eval_kwargs = dict(
+            mean=config.mean, std=config.std, compute_dtype=compute
+        )
+        if self.spatial > 1:
+            # GSPMD path: GLOBAL-semantics step (no axis_name — the
+            # compiler derives halo exchanges, BN reductions, grad
+            # all-reduce from the sharding annotations). BN statistics are
+            # globally exact here, so sync_bn has nothing to add.
+            wrap_train = lambda fn: spatial_train_step(fn, self.mesh)
+            wrap_eval = lambda fn: spatial_eval_step(fn, self.mesh)
+        else:
+            step_kwargs.update(axis_name=DATA_AXIS, sync_bn=config.sync_bn)
+            eval_kwargs.update(axis_name=DATA_AXIS)
+            wrap_train = lambda fn: data_parallel_train_step(fn, self.mesh)
+            wrap_eval = lambda fn: data_parallel_eval_step(fn, self.mesh)
         self.train_step = (
             None
             if config.evaluate
-            else data_parallel_train_step(
-                make_train_step(
-                    crop=config.random_crop and device_augment,
-                    flip=config.random_flip and device_augment,
-                    mean=config.mean,
-                    std=config.std,
-                    compute_dtype=compute,
-                    axis_name=DATA_AXIS,
-                    remat=config.remat,
-                    sync_bn=config.sync_bn,
-                ),
-                self.mesh,
-            )
+            else wrap_train(make_train_step(**step_kwargs))
         )
-        self.eval_step = data_parallel_eval_step(
-            make_eval_step(
-                mean=config.mean,
-                std=config.std,
-                compute_dtype=compute,
-                axis_name=DATA_AXIS,
-            ),
-            self.mesh,
-        )
+        self.eval_step = wrap_eval(make_eval_step(**eval_kwargs))
         self.rng = jax.random.PRNGKey(config.seed + 1)
         self._trace_dir = None  # set by fit() for the profiled epoch
         self.profile_steps = 20
@@ -281,7 +314,7 @@ class Trainer:
         for x, y in eval_batches(
             self.test_images, self.test_labels, self.eval_bs
         ):
-            batch = put_global(x, y, self.sharding)
+            batch = put_global(x, y, self.sharding, self.label_sharding)
             m = jax.device_get(self.eval_step(self.state, batch))
             loss_sum += float(m["loss_sum"])
             correct += float(m["correct"])
