@@ -1,0 +1,175 @@
+"""Pallas TPU kernels: overlapping 3x3/s1/p1 max-pool forward + backward.
+
+Why: XLA lowers the backward of an overlapping max-pool to
+``select-and-scatter``, the single most expensive op class in the zoo's
+pool-heavy models — profiled at 16.2 ms of GoogLeNet's 102.8 ms step
+(BENCHMARKS.md): every Inception cell carries a 3x3/s1 pool branch
+(reference models/googlenet.py:44-46). Elementwise reformulations in plain
+XLA measure *slower* (33-35 ms — shifted W-axis reads break (8,128) tile
+alignment in HBM; BENCHMARKS.md negative results).
+
+The kernel-level fix: the forward records, per window, WHICH of its nine
+taps won (first maximum in row-major scan order — the same tie rule as
+select-and-scatter and cuDNN's MaxPoolGrad). The backward then becomes nine
+masked accumulations over VMEM-resident tiles — shifted reads of a tile
+already in VMEM are register traffic, not misaligned HBM loads. Memory
+traffic: read g + idx, write grad (3 passes) instead of the
+select-and-scatter's windowed rescan.
+
+Status: NOT wired into the model zoo. Measured 38.1 ms vs XLA's 12.0 ms at
+(512,32,32,480) bf16 fwd+bwd (BENCHMARKS.md) — the fp32 widening in the
+9-tap scan and the int32 index map's extra HBM traffic outweigh the
+scheduling win, so ``models.common.max_pool`` stays on ``nn.max_pool``.
+Kept fully tested (``tests/test_ops.py``, interpret mode incl. exact fp32
+gradient equality with select-and-scatter) as the baseline for future
+Mosaic tuning; the roofline allows ~0.6 ms.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = float("-inf")
+
+
+def _fwd_kernel(xp_ref, out_ref, idx_ref=None, *, h, w):
+    # xp_ref: (1, h+2, w+2, c) padded input; out/idx: (1, h, w, c).
+    # idx_ref is None for the forward-only (inference) variant — the winner
+    # map is only needed to route gradients.
+    best = xp_ref[0, 0:h, 0:w, :].astype(jnp.float32)
+    idx = jnp.zeros(best.shape, jnp.int32) if idx_ref is not None else None
+    for k in range(1, 9):
+        ky, kx = divmod(k, 3)
+        cur = xp_ref[0, ky : ky + h, kx : kx + w, :].astype(jnp.float32)
+        m = cur > best  # strict: earlier (row-major) tap keeps ties
+        if idx_ref is not None:
+            idx = jnp.where(m, k, idx)
+        best = jnp.where(m, cur, best)
+    out_ref[0] = best.astype(out_ref.dtype)
+    if idx_ref is not None:
+        idx_ref[0] = idx
+
+
+def _bwd_kernel(gp_ref, ip_ref, gi_ref, *, h, w):
+    # gp/ip: (1, h+2, w+2, c) zero/9-padded grad and winner-index maps.
+    # Input position p receives window (p - k + 1)'s gradient iff that
+    # window's winner index equals k: gi[p] = sum_k [ip'[k] == k] * gp'[k]
+    # with the shifted slice [2-ky : 2-ky+h, 2-kx : 2-kx+w].
+    acc = jnp.zeros((h, w, gi_ref.shape[-1]), jnp.float32)
+    for k in range(9):
+        ky, kx = divmod(k, 3)
+        sl_h = slice(2 - ky, 2 - ky + h)
+        sl_w = slice(2 - kx, 2 - kx + w)
+        hit = ip_ref[0, sl_h, sl_w, :] == k
+        acc = acc + jnp.where(hit, gp_ref[0, sl_h, sl_w, :], 0.0).astype(
+            jnp.float32
+        )
+    gi_ref[0] = acc.astype(gi_ref.dtype)
+
+
+def _spec(shape):
+    return pl.BlockSpec(
+        shape, lambda i, j: (i, 0, 0, j), memory_space=pltpu.VMEM
+    )
+
+
+def _chunk(c: int) -> int:
+    """Channel block: full-image blocks VMEM-OOM past ~256 channels
+    (measured: 480ch fwd wants 17.5 MB scoped vs the 16 MB limit), so the
+    grid tiles channels; 128 matches the lane width."""
+    return c if c <= 128 else 128
+
+
+def _pad_channels(a, cb):
+    c = a.shape[-1]
+    if c % cb == 0:
+        return a, c
+    cpad = -(-c // cb) * cb
+    return jnp.pad(a, [(0, 0)] * 3 + [(0, cpad - c)]), c
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "emit_idx"))
+def _max_pool3x3_fwd(x, interpret=False, emit_idx=True):
+    n, h, w, _ = x.shape
+    cb = _chunk(x.shape[-1])
+    x, c = _pad_channels(x, cb)
+    cp = x.shape[-1]
+    xp = jnp.pad(
+        x, [(0, 0), (1, 1), (1, 1), (0, 0)], constant_values=_NEG
+    )
+    kernel = functools.partial(_fwd_kernel, h=h, w=w)
+    out_spec = _spec((1, h, w, cb))
+    out_shape = jax.ShapeDtypeStruct((n, h, w, cp), x.dtype)
+    if emit_idx:
+        out, idx = pl.pallas_call(
+            kernel,
+            grid=(n, cp // cb),
+            in_specs=[_spec((1, h + 2, w + 2, cb))],
+            out_specs=(out_spec, _spec((1, h, w, cb))),
+            out_shape=(
+                out_shape,
+                jax.ShapeDtypeStruct((n, h, w, cp), jnp.int32),
+            ),
+            interpret=interpret,
+        )(xp)
+        return out[..., :c], idx[..., :c]
+    out = pl.pallas_call(
+        kernel,
+        grid=(n, cp // cb),
+        in_specs=[_spec((1, h + 2, w + 2, cb))],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(xp)
+    return out[..., :c], None
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _max_pool3x3_bwd(g, idx, interpret=False):
+    n, h, w, _ = g.shape
+    cb = _chunk(g.shape[-1])
+    g, c = _pad_channels(g, cb)
+    idx, _ = _pad_channels(idx, cb)
+    cp = g.shape[-1]
+    gp = jnp.pad(g, [(0, 0), (1, 1), (1, 1), (0, 0)])
+    ip = jnp.pad(
+        idx, [(0, 0), (1, 1), (1, 1), (0, 0)], constant_values=9
+    )
+    kernel = functools.partial(_bwd_kernel, h=h, w=w)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n, cp // cb),
+        in_specs=[
+            _spec((1, h + 2, w + 2, cb)),
+            _spec((1, h + 2, w + 2, cb)),
+        ],
+        out_specs=_spec((1, h, w, cb)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w, cp), g.dtype),
+        interpret=interpret,
+    )(gp, ip)
+    return out[..., :c]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def max_pool3x3_s1(x, interpret: bool = False):
+    """3x3/stride-1/pad-1 max pool, NHWC, Pallas fwd+bwd."""
+    # primal-only call (no differentiation): skip the winner-index output
+    out, _ = _max_pool3x3_fwd(x, interpret=interpret, emit_idx=False)
+    return out
+
+
+def _vjp_fwd(x, interpret):
+    out, idx = _max_pool3x3_fwd(x, interpret=interpret)
+    return out, idx
+
+
+def _vjp_bwd(interpret, idx, g):
+    return (_max_pool3x3_bwd(g, idx, interpret=interpret),)
+
+
+max_pool3x3_s1.defvjp(_vjp_fwd, _vjp_bwd)

@@ -63,3 +63,62 @@ def test_conv_bn_relu_bf16_io():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         atol=0.1, rtol=0.1,
     )
+
+
+# ---------------------------------------------------------------------------
+# Pallas 3x3/s1 max-pool (ops/max_pool.py) — interpret mode on CPU
+# ---------------------------------------------------------------------------
+
+
+def _xla_pool(x):
+    from flax import linen as nn
+
+    return nn.max_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
+
+
+def test_max_pool3x3_forward_matches_xla():
+    from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (3, 8, 8, 16))
+    got = max_pool3x3_s1(x, True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(_xla_pool(x)))
+
+
+def test_max_pool3x3_forward_nonaligned_channels():
+    from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
+
+    # channel count that needs padding to the 128-lane block (exercises
+    # the pad/slice path with a non-divisor like GoogLeNet's 480)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 5, 5, 130))
+    got = max_pool3x3_s1(x, True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(_xla_pool(x)))
+
+
+def test_max_pool3x3_gradient_matches_select_and_scatter():
+    from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
+
+    # fp32 random data has no ties: the first-max routing must reproduce
+    # XLA's select-and-scatter gradient EXACTLY
+    x = jax.random.normal(jax.random.PRNGKey(5), (3, 8, 8, 16))
+    g_ref = jax.grad(lambda x: (_xla_pool(x) ** 2).sum())(x)
+    g_new = jax.grad(lambda x: (max_pool3x3_s1(x, True) ** 2).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g_new), np.asarray(g_ref))
+
+
+def test_max_pool3x3_gradient_mass_conserved_bf16():
+    from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
+
+    # bf16 ties may route to a different (equally maximal) tap than XLA,
+    # but every window's gradient must land on exactly one input element:
+    # total mass is conserved
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 8, 32)).astype(
+        jnp.bfloat16
+    )
+    g = jnp.ones((2, 8, 8, 32), jnp.bfloat16)
+    _, vjp = jax.vjp(lambda x: max_pool3x3_s1(x, True), x)
+    (gi,) = vjp(g)
+    np.testing.assert_allclose(
+        float(gi.astype(jnp.float32).sum()),
+        float(g.astype(jnp.float32).sum()),
+        rtol=1e-2,
+    )
