@@ -44,11 +44,10 @@ def clamp_for_cpu(args) -> str:
     return platform
 
 
-def build_step(model_name: str, batch: int, compute_dtype):
+def build_state(model_name: str, batch: int, compute_dtype):
     from pytorch_cifar_tpu.models import create_model
     from pytorch_cifar_tpu.train.optim import make_optimizer
     from pytorch_cifar_tpu.train.state import create_train_state
-    from pytorch_cifar_tpu.train.steps import make_train_step
 
     model = create_model(model_name, dtype=compute_dtype)
     # lr=1e-3, not the training recipe's 0.1: the bench trains on one fixed
@@ -57,7 +56,23 @@ def build_step(model_name: str, batch: int, compute_dtype):
     # torch reference explodes identically under the same recipe). Throughput
     # is lr-independent; the small lr keeps the finite-loss guard meaningful.
     tx = make_optimizer(lr=1e-3, t_max=200, steps_per_epoch=max(1, 50_000 // batch))
-    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    return create_train_state(model, jax.random.PRNGKey(0), tx)
+
+
+def synthetic_batch(batch: int):
+    rs = np.random.RandomState(0)
+    return (
+        jax.device_put(
+            rs.randint(0, 256, size=(batch, 32, 32, 3), dtype=np.uint8)
+        ),
+        jax.device_put(rs.randint(0, 10, size=(batch,)).astype(np.int32)),
+    )
+
+
+def build_step(model_name: str, batch: int, compute_dtype):
+    from pytorch_cifar_tpu.train.steps import make_train_step
+
+    state = build_state(model_name, batch, compute_dtype)
     step = jax.jit(
         make_train_step(compute_dtype=compute_dtype), donate_argnums=(0,)
     )
@@ -74,6 +89,35 @@ CONFIGS = {
     4: (["MobileNetV2", "EfficientNetB0"], 512),
     5: (["DenseNet121", "RegNetX_200MF", "DLA"], 512),
 }
+
+
+def run_eval(
+    model: str, batch: int, steps: int, warmup: int, compute_dtype,
+    repeats: int = 1,
+):
+    """Inference throughput: eval-mode forward (running BN stats, no
+    augmentation, no backward) — the serving-side counterpart of the
+    train metric. Sync rule as in run_one: a D2H metric fetch per block."""
+    from pytorch_cifar_tpu.train.steps import make_eval_step
+
+    state = build_state(model, batch, compute_dtype)
+    step = jax.jit(make_eval_step(compute_dtype=compute_dtype))
+    x, y = synthetic_batch(batch)
+    metrics = None
+    for _ in range(warmup):
+        metrics = step(state, (x, y))
+    if metrics is not None:
+        float(metrics["loss_sum"])
+    best = 0.0
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            metrics = step(state, (x, y))
+        loss_sum = float(metrics["loss_sum"])
+        elapsed = time.perf_counter() - t0
+        assert np.isfinite(loss_sum), f"non-finite eval loss for {model}"
+        best = max(best, steps * batch / elapsed)
+    return best
 
 
 def run_one(
@@ -187,6 +231,10 @@ def main() -> int:
         "--pipeline", action="store_true",
         help="measure host input-pipeline throughput instead of a model",
     )
+    parser.add_argument(
+        "--eval", action="store_true",
+        help="measure inference (eval-forward) throughput instead of training",
+    )
     args = parser.parse_args()
 
     platform = clamp_for_cpu(args)
@@ -211,6 +259,12 @@ def main() -> int:
         # one number per config: geometric mean across its models
         value = float(np.exp(np.mean(np.log(rates))))
         name = f"config{args.config}_" + "+".join(models) + f"_b{batch}"
+    elif args.eval:
+        value = run_eval(
+            args.model, args.batch, args.steps, args.warmup, compute_dtype,
+            repeats=args.repeats,
+        )
+        name = f"eval_throughput_{args.model}_b{args.batch}"
     else:
         # The jitted step runs on a single device (default placement, no
         # sharding), so per-chip throughput == measured throughput

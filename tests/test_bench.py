@@ -42,3 +42,25 @@ def test_bench_prints_one_json_line():
     # JAX_PLATFORMS=cpu must be honored — the exclusive TPU chip may be in
     # use by another process while tests run
     assert rec["metric"].endswith("_cpu"), rec["metric"]
+
+
+def test_bench_eval_mode_prints_one_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
+         "--steps", "2", "--warmup", "1", "--batch", "64", "--eval"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env=env,
+        check=True,
+    )
+    json_lines = [
+        l for l in out.stdout.splitlines() if l.strip().startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    rec = json.loads(json_lines[0])
+    assert rec["metric"].startswith("eval_throughput_LeNet"), rec["metric"]
+    assert rec["value"] > 0
