@@ -70,11 +70,14 @@ def synthetic_batch(batch: int):
 
 
 def build_step(model_name: str, batch: int, compute_dtype):
+    from pytorch_cifar_tpu import tpu_compiler_options
     from pytorch_cifar_tpu.train.steps import make_train_step
 
     state = build_state(model_name, batch, compute_dtype)
     step = jax.jit(
-        make_train_step(compute_dtype=compute_dtype), donate_argnums=(0,)
+        make_train_step(compute_dtype=compute_dtype),
+        donate_argnums=(0,),
+        compiler_options=tpu_compiler_options(),
     )
     return state, step
 
@@ -98,10 +101,14 @@ def run_eval(
     """Inference throughput: eval-mode forward (running BN stats, no
     augmentation, no backward) — the serving-side counterpart of the
     train metric. Sync rule as in run_one: a D2H metric fetch per block."""
+    from pytorch_cifar_tpu import tpu_compiler_options
     from pytorch_cifar_tpu.train.steps import make_eval_step
 
     state = build_state(model, batch, compute_dtype)
-    step = jax.jit(make_eval_step(compute_dtype=compute_dtype))
+    step = jax.jit(
+        make_eval_step(compute_dtype=compute_dtype),
+        compiler_options=tpu_compiler_options(),
+    )
     x, y = synthetic_batch(batch)
     metrics = None
     for _ in range(warmup):

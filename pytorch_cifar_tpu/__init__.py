@@ -37,6 +37,28 @@ def honor_platform_env() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def tpu_compiler_options(device=None):
+    """Per-compile XLA options for the jitted steps; None off-TPU.
+
+    ``xla_tpu_scoped_vmem_limit_kib=32768`` doubles the compiler's scoped
+    VMEM budget (v5e has 128 MB physical; the default budget is 16 MB),
+    buying deeper fusion tiles. Interleaved A/B on the v5e: ResNet18 b512
+    train step 33.9k -> 35.0k img/s (+3%), no regression at 64 MB.
+
+    ``device``: the device the jit will actually target (e.g.
+    ``mesh.devices.flat[0]``) — the default backend can be a different
+    platform than the mesh (a site TPU plugin owns the default while the
+    mesh is CPU, or vice versa), and the CPU compiler rejects TPU options.
+    """
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    if device.platform == "tpu":
+        return {"xla_tpu_scoped_vmem_limit_kib": "32768"}
+    return None
+
+
 def enable_compilation_cache(path: str = "/tmp/pytorch_cifar_tpu_jax_cache") -> None:
     """Persist XLA compilations across processes.
 

@@ -57,6 +57,8 @@ def data_parallel_train_step(
     step_fn: (state, (images, labels), rng) -> (state, metrics), already
     containing the pmean/psum collectives for grads/stats/metrics.
     """
+    from pytorch_cifar_tpu import tpu_compiler_options
+
     mapped = shard_map(
         step_fn,
         mesh=mesh,
@@ -64,13 +66,19 @@ def data_parallel_train_step(
         out_specs=(P(), P()),
         check_vma=False,  # states/metrics are made replicated by pmean/psum
     )
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        mapped,
+        donate_argnums=(0,) if donate else (),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+    )
 
 
 def data_parallel_eval_step(
     step_fn: Callable, mesh: Mesh, axis: str = DATA_AXIS
 ) -> Callable:
     """Wrap a per-shard eval step (``make_eval_step(axis_name=axis)``)."""
+    from pytorch_cifar_tpu import tpu_compiler_options
+
     mapped = shard_map(
         step_fn,
         mesh=mesh,
@@ -78,4 +86,4 @@ def data_parallel_eval_step(
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return jax.jit(mapped, compiler_options=tpu_compiler_options(mesh.devices.flat[0]))

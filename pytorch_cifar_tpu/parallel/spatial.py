@@ -71,6 +71,8 @@ def spatial_train_step(step_fn: Callable, mesh: Mesh, donate: bool = True):
     over the 2-D mesh. GSPMD partitions every conv spatially and inserts
     halo exchanges; state stays replicated; metrics come back replicated.
     """
+    from pytorch_cifar_tpu import tpu_compiler_options
+
     replicated = NamedSharding(mesh, P())
     return jax.jit(
         step_fn,
@@ -81,10 +83,13 @@ def spatial_train_step(step_fn: Callable, mesh: Mesh, donate: bool = True):
         ),
         out_shardings=(replicated, replicated),
         donate_argnums=(0,) if donate else (),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
     )
 
 
 def spatial_eval_step(step_fn: Callable, mesh: Mesh):
+    from pytorch_cifar_tpu import tpu_compiler_options
+
     replicated = NamedSharding(mesh, P())
     return jax.jit(
         step_fn,
@@ -93,6 +98,7 @@ def spatial_eval_step(step_fn: Callable, mesh: Mesh):
             (spatial_batch_sharding(mesh), spatial_label_sharding(mesh)),
         ),
         out_shardings=replicated,
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
     )
 
 
