@@ -15,12 +15,21 @@ tests/test_data.py and scales with host cores, not chips).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.json:
+"published": {}), so the baseline is the newest prior-round capture of the
+SAME metric in the driver's BENCH_r{N}.json history — a regression shows up
+as vs_baseline < 1. Falls back to 1.0 when no prior capture matches (round
+1, or a metric/platform not benched before).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import re
 import sys
 import time
 
@@ -214,6 +223,34 @@ def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
     return done * batch / elapsed
 
 
+def prior_round_value(metric: str):
+    """OLDEST recorded BENCH_r{N}.json value for this exact metric.
+
+    The first round that ever captured a metric is its permanent baseline:
+    a stable denominator that (a) can never be the file the CURRENT run is
+    about to produce — taking the newest would make a post-snapshot rerun
+    compare against itself and print 1.0 over a real regression — and
+    (b) keeps the ratio meaningful across many rounds (vs_baseline is
+    cumulative progress since the metric was first measured).
+    """
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None  # (round_number, value)
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                parsed = (json.load(f) or {}).get("parsed") or {}
+            if parsed.get("metric") == metric and parsed.get("value") is not None:
+                entry = (int(m.group(1)), float(parsed["value"]))
+                if best is None or entry[0] < best[0]:
+                    best = entry
+        except (OSError, ValueError):
+            continue
+    return best[1] if best else None
+
+
 def main() -> int:
     from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
 
@@ -284,13 +321,14 @@ def main() -> int:
 
     if not args.pipeline:
         metric = f"{name}_{args.dtype}_{platform}"
+    prior = prior_round_value(metric)
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": round(value, 2),
                 "unit": "images/sec/chip",
-                "vs_baseline": 1.0,
+                "vs_baseline": round(value / prior, 4) if prior else 1.0,
             }
         )
     )

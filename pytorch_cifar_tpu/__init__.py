@@ -59,7 +59,7 @@ def tpu_compiler_options(device=None):
     return None
 
 
-def enable_compilation_cache(path: str = "/tmp/pytorch_cifar_tpu_jax_cache") -> None:
+def enable_compilation_cache(path: str = None) -> None:
     """Persist XLA compilations across processes.
 
     TPU compiles of the fused train step are expensive (measured on the
@@ -68,9 +68,27 @@ def enable_compilation_cache(path: str = "/tmp/pytorch_cifar_tpu_jax_cache") -> 
     on-disk cache turns every repeat compile into a ~1 s deserialization.
     Entry points (train.py, bench.py, tools/) call this; tests do not (CPU
     compiles are fast, and cache writes would race under pytest-xdist).
+
+    Default location is per-user (override with $PYTORCH_CIFAR_TPU_CACHE):
+    a world-shared path breaks on multi-user machines — the second user hits
+    a permission error on the first user's directory.
     """
+    import os
+    import tempfile
+
     import jax
 
+    if path is None:
+        # getpass.getuser() raises KeyError under a passwd-less UID (e.g.
+        # k8s runAsUser) with no USER/LOGNAME set; fall back to the uid
+        user = (
+            os.environ.get("USER")
+            or os.environ.get("LOGNAME")
+            or f"uid{os.getuid()}"
+        )
+        path = os.environ.get("PYTORCH_CIFAR_TPU_CACHE") or os.path.join(
+            tempfile.gettempdir(), f"pytorch_cifar_tpu_jax_cache-{user}"
+        )
     jax.config.update("jax_compilation_cache_dir", path)
     # cache everything: the default min-entry-size skips small programs,
     # but on this platform even tiny-model steps take minutes to compile

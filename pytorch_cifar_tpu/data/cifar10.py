@@ -170,7 +170,13 @@ def synthetic_cifar10(
     return train_x, train_y, test_x, test_y
 
 
-def load_cifar10(data_dir: str = "./data", synthetic_ok: bool = True) -> Arrays:
+def load_cifar10(data_dir: str = "./data", synthetic_ok: bool = False) -> Arrays:
+    """Load real CIFAR-10, or raise with remediation advice.
+
+    ``synthetic_ok=True`` (explicit opt-in only — a silent fallback would
+    make accuracy numbers meaningless) substitutes the deterministic
+    synthetic set with a loud warning.
+    """
     found = _find_dataset(data_dir)
     if found is None:
         path = _try_download(data_dir)
@@ -188,6 +194,10 @@ def load_cifar10(data_dir: str = "./data", synthetic_ok: bool = True) -> Arrays:
         )
         return synthetic_cifar10()
     raise FileNotFoundError(
-        f"CIFAR-10 not found under {data_dir!r} and download failed; "
-        "set CIFAR10_PATH or pass synthetic_ok=True"
+        f"CIFAR-10 not found under {data_dir!r} and download failed "
+        f"(offline?). Provide the dataset: extract cifar-10-python.tar.gz "
+        f"(-> cifar-10-batches-py/) or cifar-10-binary.tar.gz "
+        f"(-> cifar-10-batches-bin/) under {data_dir!r}, or point "
+        "CIFAR10_PATH at the batch directory. For a no-dataset smoke run "
+        "pass --synthetic_data (accuracies then mean nothing)."
     )

@@ -33,6 +33,57 @@ import numpy as np
 from pytorch_cifar_tpu.native import augment_batch_u8, gather_batch
 
 
+def local_slab(
+    sharding: jax.sharding.Sharding, global_shape: Tuple[int, ...]
+) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """This process's addressable box of a global array: ((b_lo,b_hi),(h_lo,h_hi)).
+
+    Generalizes the 1-D "rows [pid*B/P, (pid+1)*B/P)" DistributedSampler
+    arithmetic to 2-D (batch x spatial) shardings, where a process can own a
+    batch range, a height range, or both (parallel/spatial.py). NamedSharding
+    lays mesh axes out as a cartesian grid and ``jax.devices()`` orders
+    devices by process, so the union of a process's shard indices is always
+    an axis-aligned box — asserted, not assumed.
+    """
+    imap = sharding.addressable_devices_indices_map(global_shape)
+
+    def bounds(dim):
+        los, his = set(), set()
+        for idx in imap.values():
+            sl = idx[dim] if dim < len(idx) else slice(None)
+            los.add(0 if sl.start is None else int(sl.start))
+            his.add(global_shape[dim] if sl.stop is None else int(sl.stop))
+        return min(los), max(his)
+
+    (b_lo, b_hi) = bounds(0)
+    (h_lo, h_hi) = bounds(1) if len(global_shape) > 1 else (0, 0)
+    # box check: total addressable elements == box volume (no gaps/overlap
+    # beyond replication). Replicated shards repeat the same index; dedupe.
+    boxes = {
+        tuple(
+            (
+                0 if s.start is None else int(s.start),
+                global_shape[d] if s.stop is None else int(s.stop),
+            )
+            for d, s in enumerate(idx)
+        )
+        for idx in imap.values()
+    }
+    vol = sum(
+        int(np.prod([hi - lo for lo, hi in box])) for box in boxes
+    )
+    box_dims = [b_hi - b_lo, h_hi - h_lo] + [
+        global_shape[d] for d in range(2, len(global_shape))
+    ]
+    expect = int(np.prod(box_dims[: len(global_shape)]))
+    if vol != expect:
+        raise ValueError(
+            f"process-local shards of {sharding} do not form a contiguous "
+            f"box over {global_shape} — unsupported device order"
+        )
+    return (b_lo, b_hi), (h_lo, h_hi)
+
+
 class Dataloader:
     """Iterates (images_uint8, labels_int32) device batches for one epoch."""
 
@@ -65,9 +116,14 @@ class Dataloader:
         # stay batch-only — pass both then
         self.label_sharding = label_sharding if label_sharding is not None else sharding
         self.shuffle = shuffle
-        # Like the reference's drop_last=False default, a ragged final batch
-        # would retrigger XLA compilation per distinct shape; on TPU we drop
-        # it for train and pad for eval (see eval_batches).
+        # drop_last=False matches the reference DataLoader default
+        # (main.py:44-45: every image trains every epoch). A ragged final
+        # batch would retrigger XLA compilation per distinct shape, so the
+        # tail batch is padded to full size with wrap-around images from the
+        # start of the epoch's permutation: real pixels keep BatchNorm batch
+        # statistics clean (zero-fill would inject constant images into the
+        # moments), while their -1 labels mask them out of the loss,
+        # gradients, and metrics (steps.py masks label < 0 everywhere).
         self.drop_last = drop_last
         self.seed = seed
         self.sharding = sharding
@@ -97,47 +153,62 @@ class Dataloader:
             (self.seed * 9973 + epoch * 31 + 7) % (2**31)
         )
 
-        # multi-host: this process materializes only its slice of each
-        # global batch; rows [pid*B/P, (pid+1)*B/P) of the shared permutation
-        pid, pcount = jax.process_index(), jax.process_count()
-        local_bs = self.batch_size // pcount if pcount > 1 else self.batch_size
-        if pcount > 1 and self.batch_size % pcount:
-            raise ValueError(
-                f"batch_size {self.batch_size} not divisible by "
-                f"{pcount} processes"
+        # multi-host: this process materializes only its slab of each global
+        # batch. For batch-only sharding that is the classic DistributedSampler
+        # rows [pid*B/P, (pid+1)*B/P) (main_dist.py:110); for 2-D
+        # batch x spatial shardings the slab can also be a height range
+        # (multi-host spatial partitioning) — local_slab derives both from
+        # the sharding itself.
+        img_shape = self.images.shape[1:]
+        if jax.process_count() > 1:
+            if self.sharding is None:
+                raise ValueError(
+                    "multi-process Dataloader requires a batch sharding"
+                )
+            (r0, r1), (h0, h1) = local_slab(
+                self.sharding, (self.batch_size,) + tuple(img_shape)
             )
+        else:
+            (r0, r1), (h0, h1) = (0, self.batch_size), (0, img_shape[0])
+        local_bs = r1 - r0
 
         def host_batches():
             for b in range(nb):
-                lo = b * self.batch_size + pid * local_bs
-                idx = order[lo : lo + local_bs]
+                lo = b * self.batch_size + r0
+                hi = lo + local_bs
+                if hi <= n and lo < n:
+                    idx, valid = order[lo:hi], None
+                else:
+                    # ragged final batch (drop_last=False): wrap-pad with
+                    # images from the start of this epoch's permutation so
+                    # shard shapes stay full across processes; the wrapped
+                    # rows carry -1 labels and are masked downstream
+                    j = np.arange(lo, hi)
+                    idx, valid = order[j % n], j < n
                 # native parallel gather (OpenMP memcpy, GIL released) with a
                 # numpy fancy-indexing fallback — native/cifar_native.cpp
                 x, y = gather_batch(self.images, self.labels, idx)
+                if valid is not None:
+                    y = np.where(valid, y, np.int32(-1)).astype(y.dtype)
                 if self.host_augment:
                     pad = self.augment_padding
                     # draw for the FULL global batch and slice this
                     # process's rows: every process consumes the same
                     # stream, so augmentation stays decorrelated across
                     # shards and topology-invariant vs single-process
-                    n = x.shape[0]
-                    s = slice(pid * local_bs, pid * local_bs + n)
+                    nx = x.shape[0]
+                    s = slice(r0, r0 + nx)
                     dx = aug_rng.randint(0, 2 * pad + 1, self.batch_size)[s]
                     dy = aug_rng.randint(0, 2 * pad + 1, self.batch_size)[s]
                     fl = aug_rng.randint(
                         0, 2 if self.augment_flip else 1, self.batch_size
                     )[s]
                     x = augment_batch_u8(x, dx, dy, fl, padding=pad)
-                if not self.drop_last and x.shape[0] < local_bs:
-                    # every process pads its slice to exactly local_bs so
-                    # shard shapes stay consistent across processes on the
-                    # ragged final batch (a process's slice can even be
-                    # empty); -1 labels are masked out of the metrics
-                    pad = local_bs - x.shape[0]
-                    x = np.concatenate(
-                        [x, np.zeros((pad,) + x.shape[1:], x.dtype)]
-                    )
-                    y = np.concatenate([y, np.full((pad,), -1, y.dtype)])
+                if (h0, h1) != (0, img_shape[0]):
+                    # 2-D slab: this process holds a height range; slice
+                    # AFTER augmentation (crops move pixels across shard
+                    # boundaries, so the full image must exist first)
+                    x = np.ascontiguousarray(x[:, h0:h1])
                 yield x, y
 
         # double-buffer: keep `prefetch` batches in flight on device
@@ -159,9 +230,15 @@ class Dataloader:
                 raise ValueError(
                     "multi-process Dataloader requires a batch sharding"
                 )
-            # assemble the global array from this process's local shard
-            x = jax.make_array_from_process_local_data(self.sharding, x)
-            y = jax.make_array_from_process_local_data(self.label_sharding, y)
+            # assemble the global array from this process's local slab;
+            # explicit global_shape so 2-D (batch x height) slabs resolve
+            # unambiguously (a dim matching the global size is read whole,
+            # a smaller one is mapped from the process's addressable slices)
+            gx = (self.batch_size,) + tuple(self.images.shape[1:])
+            x = jax.make_array_from_process_local_data(self.sharding, x, gx)
+            y = jax.make_array_from_process_local_data(
+                self.label_sharding, y, (self.batch_size,)
+            )
         elif self.sharding is not None:
             x = jax.device_put(x, self.sharding)
             y = jax.device_put(y, self.label_sharding)
@@ -189,17 +266,17 @@ def put_global(
     if jax.process_count() > 1:
         if sharding is None:
             raise ValueError("multi-process put_global requires a sharding")
-        pid, pcount = jax.process_index(), jax.process_count()
-        if x.shape[0] % pcount:
-            raise ValueError(
-                f"global batch {x.shape[0]} not divisible by {pcount} processes"
-            )
-        lb = x.shape[0] // pcount
-        xl = x[pid * lb : (pid + 1) * lb]
-        yl = y[pid * lb : (pid + 1) * lb]
+        (r0, r1), (h0, h1) = local_slab(sharding, x.shape)
+        xl = x[r0:r1]
+        if (h0, h1) != (0, x.shape[1]):
+            xl = np.ascontiguousarray(xl[:, h0:h1])
+        (y0, y1), _ = local_slab(label_sharding, y.shape)
+        yl = y[y0:y1]
         return (
-            jax.make_array_from_process_local_data(sharding, xl),
-            jax.make_array_from_process_local_data(label_sharding, yl),
+            jax.make_array_from_process_local_data(sharding, xl, x.shape),
+            jax.make_array_from_process_local_data(
+                label_sharding, yl, y.shape
+            ),
         )
     if sharding is not None:
         return jax.device_put(x, sharding), jax.device_put(y, label_sharding)

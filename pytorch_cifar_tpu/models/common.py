@@ -51,7 +51,14 @@ _SYNC_BN_AXIS: contextvars.ContextVar = contextvars.ContextVar(
 
 @contextlib.contextmanager
 def sync_batchnorm(axis_name: Optional[str]):
-    """Trace-time context: BatchNorms psum batch moments over ``axis_name``."""
+    """Trace-time context: BatchNorms psum batch moments over ``axis_name``.
+
+    The contextvar is process-global trace-time state: tracing two models
+    concurrently from different threads while one holds this context could
+    leak the axis into the other trace. Fine here — the framework traces
+    single-threaded (one jitted step per Trainer) — but keep it in mind if
+    embedding these modules in a multi-threaded tracing harness.
+    """
     token = _SYNC_BN_AXIS.set(axis_name)
     try:
         yield

@@ -31,28 +31,33 @@ from pytorch_cifar_tpu.train.state import TrainState
 Metrics = dict
 
 
-def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Mean CE over valid (label >= 0) entries, computed in fp32."""
-    logits = logits.astype(jnp.float32)
+def cross_entropy_sums(logits: jax.Array, labels: jax.Array):
+    """(sum of CE over valid rows, valid count) in fp32; labels < 0 are
+    padding (pipeline.py wrap-pad / eval_batches) and contribute nothing.
+    The single source of the masking rule — loss, gradients, and metrics
+    all reduce these same two numbers."""
     valid = labels >= 0
     losses = optax.softmax_cross_entropy_with_integer_labels(
-        logits, jnp.maximum(labels, 0)
+        logits.astype(jnp.float32), jnp.maximum(labels, 0)
     )
-    losses = jnp.where(valid, losses, 0.0)
-    return losses.sum() / jnp.maximum(valid.sum(), 1)
+    return jnp.where(valid, losses, 0.0).sum(), valid.sum()
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over valid (label >= 0) entries, computed in fp32."""
+    loss_sum, n_valid = cross_entropy_sums(logits, labels)
+    return loss_sum / jnp.maximum(n_valid, 1)
 
 
 def _metrics(logits, labels) -> Metrics:
     valid = labels >= 0
     pred = jnp.argmax(logits, axis=-1)
     correct = jnp.sum((pred == labels) & valid)
-    losses = optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), jnp.maximum(labels, 0)
-    )
+    loss_sum, n_valid = cross_entropy_sums(logits, labels)
     return {
-        "loss_sum": jnp.where(valid, losses, 0.0).sum(),
+        "loss_sum": loss_sum,
         "correct": correct.astype(jnp.float32),
-        "count": valid.sum().astype(jnp.float32),
+        "count": n_valid.astype(jnp.float32),
     }
 
 
@@ -110,7 +115,20 @@ def make_train_step(
 
         def loss_fn(params):
             logits, mutated = fwd(params, x, key)
-            loss = cross_entropy(logits, labels)
+            loss_sum, n_valid = cross_entropy_sums(logits, labels)
+            if axis_name is None:
+                loss = loss_sum / jnp.maximum(n_valid, 1)
+            else:
+                # global-batch-mean CE. With a wrap-padded ragged batch
+                # (pipeline.py drop_last=False) shards can hold different
+                # valid counts; a local mean + pmean(grads) would upweight
+                # examples on light shards. Scaling the local sum by
+                # P/global_count makes the later pmean reduce exactly to
+                # global_sum/global_count — the reference's per-batch mean
+                # (main.py:103).
+                n_global = jax.lax.psum(n_valid, axis_name)
+                n_dev = jax.lax.psum(jnp.ones((), jnp.float32), axis_name)
+                loss = loss_sum * n_dev / jnp.maximum(n_global, 1)
             return loss, (logits, mutated.get("batch_stats", state.batch_stats))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(

@@ -115,6 +115,44 @@ def test_dataloader_epoch_reshuffle_deterministic():
     assert len(e0a) == 4
 
 
+def test_dataloader_full_coverage_wrap_padding():
+    """drop_last=False (the trainer default): every image is a valid row
+    exactly once per epoch (the reference's all-50k coverage, main.py:44-45);
+    the ragged tail batch keeps the static full shape, wrap-padded with REAL
+    images from the start of the permutation under -1 labels."""
+    n, bs = 70, 16
+    x = np.zeros((n, 32, 32, 3), np.uint8)
+    x[:, 0, 0, 0] = np.arange(n)  # identity encoded in a pixel
+    y = np.arange(n, dtype=np.int32)
+    dl = Dataloader(x, y, batch_size=bs, drop_last=False, seed=1)
+    assert len(dl) == -(-n // bs) == 5
+    xs, ys = [], []
+    for bx, by in dl.epoch(0):
+        assert bx.shape[0] == bs  # static shape: no per-epoch recompilation
+        xs.append(np.asarray(bx))
+        ys.append(np.asarray(by))
+    xs, ys = np.concatenate(xs), np.concatenate(ys)
+    valid = ys >= 0
+    assert valid.sum() == n
+    assert sorted(ys[valid].tolist()) == list(range(n))
+    # pad rows hold real pixels (BN-stat hygiene), duplicating the first
+    # images of this epoch's permutation in order
+    n_pad = bs * len(dl) - n
+    np.testing.assert_array_equal(
+        xs[~valid][:, 0, 0, 0], xs[:n_pad, 0, 0, 0]
+    )
+    np.testing.assert_array_equal(np.where(~valid)[0], np.arange(n, n + n_pad))
+
+
+def test_dataloader_drop_last_still_drops():
+    x = np.zeros((70, 32, 32, 3), np.uint8)
+    y = np.arange(70, dtype=np.int32)
+    dl = Dataloader(x, y, batch_size=16, drop_last=True)
+    batches = list(dl.epoch(0))
+    assert len(dl) == len(batches) == 4
+    assert all(np.asarray(b[1]).min() >= 0 for b in batches)
+
+
 def test_eval_batches_padding():
     x = np.zeros((10, 32, 32, 3), np.uint8)
     y = np.arange(10, dtype=np.int32)

@@ -90,6 +90,44 @@ def test_dp_matches_single_device_gradients():
     )
 
 
+def test_dp_ragged_batch_matches_masked_single_device():
+    """A wrap-padded ragged batch (labels -1 on pad rows, pipeline.py
+    drop_last=False) must produce the exact global-mean-over-valid update
+    under DP. The pad rows land unevenly across the 8 shards (here shards
+    carry 4,4,4,4,4,1,0,0 valid rows), so a naive local-mean + pmean would
+    systematically upweight the light shards — this pins the
+    psum-normalized loss in steps.py."""
+    x, y = make_batch(32, seed=5)
+    y = y.copy()
+    y[21:] = -1  # 21 valid rows, 11 wrap-pad rows
+
+    state1 = make_state(seed=2)
+    step1 = jax.jit(make_train_step(augment=False))
+    state1, m1 = step1(
+        state1, (jnp.asarray(x), jnp.asarray(y)), jax.random.PRNGKey(0)
+    )
+
+    mesh = make_mesh()
+    state8 = replicate(make_state(seed=2), mesh)
+    sh = batch_sharding(mesh)
+    step8 = data_parallel_train_step(
+        make_train_step(augment=False, axis_name=DATA_AXIS), mesh
+    )
+    state8, m8 = step8(
+        state8, (jax.device_put(x, sh), jax.device_put(y, sh)),
+        jax.random.PRNGKey(0),
+    )
+
+    assert float(m8["count"]) == 21
+    p1 = jax.tree_util.tree_leaves(state1.params)
+    p8 = jax.tree_util.tree_leaves(jax.device_get(state8.params))
+    for a, b in zip(p1, p8):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(
+        float(m1["loss_sum"]), float(m8["loss_sum"]), rtol=1e-5
+    )
+
+
 def test_dp_eval_metrics_reduce_and_mask_padding():
     mesh = make_mesh()
     state = replicate(make_state(), mesh)

@@ -1,0 +1,163 @@
+"""The north-star accuracy run: ResNet-18 to >=95% top-1 on CIFAR-10.
+
+Runs the reference single-node recipe (main.py:86-89,151: SGD momentum 0.9,
+wd 5e-4, lr 0.1 with cosine T_max == epochs, RandomCrop(32,4)+HFlip, 200
+epochs) through this framework's Trainer and records everything the
+BASELINE.json target asks for: per-epoch accuracy, best accuracy,
+epochs-to-95%, and wall-clock — as JSON next to the checkpoint plus the
+standard train.log.
+
+Usage:
+  python tools/accuracy_run.py --out runs/acc_bf16            # the recipe
+  python tools/accuracy_run.py --out runs/acc_fp32 --dtype float32
+  python tools/accuracy_run.py --out runs/wallclock --wallclock-only
+
+``--wallclock-only``: real CIFAR-10 is not present in every environment
+(this repo's build sandbox has zero egress). Compute cost is data-
+independent, so this mode times the EXACT recipe — 50,000 train / 10,000
+test images of synthetic data, identical shapes, identical step count —
+and reports the honest wall-clock for the "<5 min" half of the target
+while the accuracy half awaits a dataset (it refuses to print an accuracy
+for synthetic data).
+
+The bf16-vs-fp32 A/B (VERDICT round-1 missing item 3): run twice with
+--dtype bfloat16 / float32 and compare the recorded curves; the recipe
+defaults match main.py exactly, which is fp32 (the reference's AMP is
+opt-in and dist-only, main_dist.py:46).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="ResNet18")
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--epochs", type=int, default=200)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument(
+        "--dtype", default="bfloat16", choices=["bfloat16", "float32"],
+        help="bfloat16 is this framework's TPU-first default; float32 is "
+        "the literal reference recipe (main.py has no AMP)",
+    )
+    parser.add_argument("--data_dir", default="./data")
+    parser.add_argument("--out", default="./runs/accuracy")
+    parser.add_argument("--target", type=float, default=95.0)
+    parser.add_argument(
+        "--wallclock-only", action="store_true",
+        help="no dataset: time the identical-shape recipe on synthetic data",
+    )
+    parser.add_argument(
+        "--sync_bn", action="store_true",
+        help="cross-replica BN (default off matches the reference's "
+        "per-replica BN under DDP)",
+    )
+    args = parser.parse_args()
+
+    from pytorch_cifar_tpu.config import TrainConfig
+    from pytorch_cifar_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model=args.model,
+        lr=args.lr,
+        epochs=args.epochs,
+        batch_size=args.batch,
+        data_dir=args.data_dir,
+        output_dir=args.out,
+        amp=args.dtype == "bfloat16",
+        sync_bn=args.sync_bn,
+        synthetic_data=args.wallclock_only,
+        synthetic_train_size=50_000,
+        synthetic_test_size=10_000,
+        log_every=100,
+    )
+    os.makedirs(args.out, exist_ok=True)
+    trainer = Trainer(cfg)
+
+    history = []
+    epochs_to_target = None
+    t0 = time.time()
+    t_first_step = None  # set after epoch 0 (excludes compile time)
+    for epoch in range(cfg.epochs):
+        te0 = time.time()
+        train_loss, train_acc = trainer.train_epoch(epoch)
+        eval_loss, eval_acc = trainer.eval_epoch(epoch)
+        trainer.maybe_checkpoint(epoch, eval_acc)
+        if t_first_step is None:
+            t_first_step = time.time()  # epoch 0 absorbed all the compiles
+        history.append(
+            {
+                "epoch": epoch,
+                "train_loss": round(train_loss, 4),
+                "train_acc": round(train_acc, 2),
+                "eval_loss": round(eval_loss, 4),
+                "eval_acc": round(eval_acc, 2),
+                "epoch_seconds": round(time.time() - te0, 2),
+            }
+        )
+        if epochs_to_target is None and eval_acc >= args.target:
+            epochs_to_target = epoch + 1
+        # incremental write: a preemption at epoch 150 keeps 149 epochs of
+        # curve on disk
+        _write_summary(
+            args, cfg, history, epochs_to_target, t0, t_first_step, trainer
+        )
+    wall = time.time() - t0
+    summary = _write_summary(
+        args, cfg, history, epochs_to_target, t0, t_first_step, trainer
+    )
+    print(json.dumps(summary, indent=1))
+    return 0
+
+
+def _write_summary(args, cfg, history, epochs_to_target, t0, t_first, trainer):
+    wall = time.time() - t0
+    summary = {
+        "recipe": {
+            "model": args.model,
+            "batch": cfg.batch_size,
+            "lr": cfg.lr,
+            "epochs": cfg.epochs,
+            "dtype": args.dtype,
+            "momentum": cfg.momentum,
+            "weight_decay": cfg.weight_decay,
+            "cosine_t_max": cfg.t_max,
+            "sync_bn": cfg.sync_bn,
+        },
+        "synthetic_data": bool(cfg.synthetic_data),
+        # accuracy fields are honest-or-absent: synthetic runs time the
+        # recipe but cannot claim CIFAR-10 accuracy
+        "best_acc": None if cfg.synthetic_data else round(trainer.best_acc, 2),
+        "epochs_to_%g" % args.target: (
+            None if cfg.synthetic_data else epochs_to_target
+        ),
+        "epochs_run": len(history),
+        "wall_clock_seconds": round(wall, 1),
+        # epochs 1..N-1 only: epoch 0 absorbs the one-time XLA compiles,
+        # which a warm compilation cache removes from real deployments
+        "wall_clock_after_first_epoch_seconds": (
+            round(time.time() - t_first, 1) if t_first else None
+        ),
+        "history": history,
+    }
+    with open(os.path.join(args.out, "accuracy_run.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    return summary
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,9 @@ def main() -> int:
     parser.add_argument("--batch", type=int, default=512)
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--warmup", type=int, default=10)
+    # best-of-blocks like bench.py: single blocks are exposed to the ~20%
+    # tunnel variance documented in BENCHMARKS.md (28.8k-35.0k spread)
+    parser.add_argument("--repeats", type=int, default=2)
     parser.add_argument("--out", default=None, help="write JSON results here")
     args = parser.parse_args()
 
@@ -77,7 +80,10 @@ def main() -> int:
     for name in names:
         t0 = time.perf_counter()
         try:
-            rate = run_one(name, args.batch, args.steps, args.warmup, jnp.bfloat16)
+            rate = run_one(
+                name, args.batch, args.steps, args.warmup, jnp.bfloat16,
+                repeats=args.repeats,
+            )
         except Exception as e:  # keep sweeping past a single bad model
             print(f"{name:20s} FAILED: {type(e).__name__}: {e}", flush=True)
             results[name] = {"error": f"{type(e).__name__}: {e}"}
