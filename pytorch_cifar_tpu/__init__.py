@@ -32,9 +32,27 @@ def honor_platform_env() -> None:
     import os
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # XLA:CPU's in-process collective rendezvous has a 40 s termination
+        # timeout that abort()s the process. On an oversubscribed host
+        # (this CI VM has ONE core under 8 virtual devices) a straggler
+        # partition can legitimately take longer than that to reach an
+        # all-reduce while its peers spin-wait. Liveness timeouts, not
+        # correctness: raise them before the backend reads XLA_FLAGS.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "collective_call_terminate" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
+                " --xla_cpu_collective_call_terminate_timeout_seconds=300"
+            ).strip()
+
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # concurrent multi-partition executions additionally contend for
+        # the same worker threads; serializing CPU dispatch keeps one
+        # execution's partitions from starving another's rendezvous
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 
 def tpu_compiler_options(device=None):

@@ -12,17 +12,26 @@ The kernel-level fix: the forward records, per window, WHICH of its nine
 taps won (first maximum in row-major scan order — the same tie rule as
 select-and-scatter and cuDNN's MaxPoolGrad). The backward then becomes nine
 masked accumulations over VMEM-resident tiles — shifted reads of a tile
-already in VMEM are register traffic, not misaligned HBM loads. Memory
-traffic: read g + idx, write grad (3 passes) instead of the
-select-and-scatter's windowed rescan.
+already in VMEM are register traffic, not misaligned HBM loads.
 
-Status: NOT wired into the model zoo. Measured 38.1 ms vs XLA's 12.0 ms at
-(512,32,32,480) bf16 fwd+bwd (BENCHMARKS.md) — the fp32 widening in the
-9-tap scan and the int32 index map's extra HBM traffic outweigh the
-scheduling win, so ``models.common.max_pool`` stays on ``nn.max_pool``.
-Kept fully tested (``tests/test_ops.py``, interpret mode incl. exact fp32
-gradient equality with select-and-scatter) as the baseline for future
-Mosaic tuning; the roofline allows ~0.6 ms.
+Status: NOT yet wired into the model zoo — ``models.common.max_pool``
+still dispatches to ``nn.max_pool`` (XLA select-and-scatter backward,
+12.0 ms at the GoogLeNet shape); it switches over only if the on-chip
+A/B below lands faster. Correctness is pinned either way by
+``tests/test_ops.py`` (interpret-mode exact fp32 gradient equality with
+select-and-scatter).
+
+Round-2 rewrite (vs the round-1 version measured at 38.1 ms against XLA's
+12.0 ms at (512,32,32,480) bf16 fwd+bwd):
+- NO HBM pre-padding: the round-1 version ``jnp.pad``-ed x (and in the
+  backward both g and the index map) to (N,34,34,C) in HBM — three extra
+  full-tensor copies through the bandwidth roof. Padding now happens on
+  the VMEM tile inside the kernel.
+- int8 winner map (was int32): 4x less index traffic in both directions.
+- native-dtype compare chain (was fp32-widened): bf16 max/compare is
+  exact for bf16 inputs; no conversion passes.
+- batch-blocked grid (8 images per program instead of 1): fewer grid
+  steps, deeper DMA pipelining.
 """
 
 from __future__ import annotations
@@ -37,39 +46,50 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = float("-inf")
 
 
-def _fwd_kernel(xp_ref, out_ref, idx_ref=None, *, h, w):
-    # xp_ref: (1, h+2, w+2, c) padded input; out/idx: (1, h, w, c).
+def _fwd_kernel(x_ref, out_ref, idx_ref=None, *, h, w):
+    # x_ref: (nb, h, w, c) unpadded input tile; out/idx: (nb, h, w, c).
     # idx_ref is None for the forward-only (inference) variant — the winner
     # map is only needed to route gradients.
-    best = xp_ref[0, 0:h, 0:w, :].astype(jnp.float32)
-    idx = jnp.zeros(best.shape, jnp.int32) if idx_ref is not None else None
+    x = x_ref[...]
+    xp = jnp.pad(
+        x, [(0, 0), (1, 1), (1, 1), (0, 0)], constant_values=_NEG
+    )  # VMEM-local halo, not an HBM copy
+    best = xp[:, 0:h, 0:w, :]
+    idx = jnp.zeros(best.shape, jnp.int8) if idx_ref is not None else None
     for k in range(1, 9):
         ky, kx = divmod(k, 3)
-        cur = xp_ref[0, ky : ky + h, kx : kx + w, :].astype(jnp.float32)
+        cur = xp[:, ky : ky + h, kx : kx + w, :]
         m = cur > best  # strict: earlier (row-major) tap keeps ties
         if idx_ref is not None:
-            idx = jnp.where(m, k, idx)
+            idx = jnp.where(m, jnp.int8(k), idx)
         best = jnp.where(m, cur, best)
-    out_ref[0] = best.astype(out_ref.dtype)
+    out_ref[...] = best.astype(out_ref.dtype)
     if idx_ref is not None:
-        idx_ref[0] = idx
+        idx_ref[...] = idx
 
 
-def _bwd_kernel(gp_ref, ip_ref, gi_ref, *, h, w):
-    # gp/ip: (1, h+2, w+2, c) zero/9-padded grad and winner-index maps.
+def _bwd_kernel(g_ref, i_ref, gi_ref, *, h, w):
+    # g/i: (nb, h, w, c) unpadded window-grad and winner-index tiles.
     # Input position p receives window (p - k + 1)'s gradient iff that
-    # window's winner index equals k: gi[p] = sum_k [ip'[k] == k] * gp'[k]
-    # with the shifted slice [2-ky : 2-ky+h, 2-kx : 2-kx+w].
-    acc = jnp.zeros((h, w, gi_ref.shape[-1]), jnp.float32)
+    # window's winner index equals k: gi[p] = sum_k [i'[k] == k] * g'[k]
+    # with the shifted slice [2-ky : 2-ky+h, 2-kx : 2-kx+w] of the
+    # VMEM-padded tiles (pad value 9 can never match a real tap index).
+    gp = jnp.pad(g_ref[...], [(0, 0), (1, 1), (1, 1), (0, 0)])
+    ip = jnp.pad(
+        i_ref[...], [(0, 0), (1, 1), (1, 1), (0, 0)],
+        constant_values=jnp.int8(9),
+    )
+    nb = gp.shape[0]
+    acc = jnp.zeros((nb, h, w, gi_ref.shape[-1]), jnp.float32)
     for k in range(9):
         ky, kx = divmod(k, 3)
         sl_h = slice(2 - ky, 2 - ky + h)
         sl_w = slice(2 - kx, 2 - kx + w)
-        hit = ip_ref[0, sl_h, sl_w, :] == k
-        acc = acc + jnp.where(hit, gp_ref[0, sl_h, sl_w, :], 0.0).astype(
+        hit = ip[:, sl_h, sl_w, :] == k
+        acc = acc + jnp.where(hit, gp[:, sl_h, sl_w, :], 0).astype(
             jnp.float32
         )
-    gi_ref[0] = acc.astype(gi_ref.dtype)
+    gi_ref[...] = acc.astype(gi_ref.dtype)
 
 
 def _spec(shape):
@@ -79,10 +99,18 @@ def _spec(shape):
 
 
 def _chunk(c: int) -> int:
-    """Channel block: full-image blocks VMEM-OOM past ~256 channels
-    (measured: 480ch fwd wants 17.5 MB scoped vs the 16 MB limit), so the
-    grid tiles channels; 128 matches the lane width."""
+    """Channel block: 128 matches the lane width; small channel counts run
+    whole."""
     return c if c <= 128 else 128
+
+
+def _batch_chunk(n: int) -> int:
+    """Images per program: 8 amortizes grid/DMA overhead; VMEM per block at
+    (8,32,32,128) is in+out+idx ~= 5 MB of the 16 MB budget."""
+    for nb in (8, 4, 2, 1):
+        if n % nb == 0:
+            return nb
+    return 1
 
 
 def _pad_channels(a, cb):
@@ -99,33 +127,32 @@ def _max_pool3x3_fwd(x, interpret=False, emit_idx=True):
     cb = _chunk(x.shape[-1])
     x, c = _pad_channels(x, cb)
     cp = x.shape[-1]
-    xp = jnp.pad(
-        x, [(0, 0), (1, 1), (1, 1), (0, 0)], constant_values=_NEG
-    )
+    nb = _batch_chunk(n)
     kernel = functools.partial(_fwd_kernel, h=h, w=w)
-    out_spec = _spec((1, h, w, cb))
+    grid = (n // nb, cp // cb)
+    out_spec = _spec((nb, h, w, cb))
     out_shape = jax.ShapeDtypeStruct((n, h, w, cp), x.dtype)
     if emit_idx:
         out, idx = pl.pallas_call(
             kernel,
-            grid=(n, cp // cb),
-            in_specs=[_spec((1, h + 2, w + 2, cb))],
-            out_specs=(out_spec, _spec((1, h, w, cb))),
+            grid=grid,
+            in_specs=[_spec((nb, h, w, cb))],
+            out_specs=(out_spec, _spec((nb, h, w, cb))),
             out_shape=(
                 out_shape,
-                jax.ShapeDtypeStruct((n, h, w, cp), jnp.int32),
+                jax.ShapeDtypeStruct((n, h, w, cp), jnp.int8),
             ),
             interpret=interpret,
-        )(xp)
+        )(x)
         return out[..., :c], idx[..., :c]
     out = pl.pallas_call(
         kernel,
-        grid=(n, cp // cb),
-        in_specs=[_spec((1, h + 2, w + 2, cb))],
+        grid=grid,
+        in_specs=[_spec((nb, h, w, cb))],
         out_specs=out_spec,
         out_shape=out_shape,
         interpret=interpret,
-    )(xp)
+    )(x)
     return out[..., :c], None
 
 
@@ -136,22 +163,16 @@ def _max_pool3x3_bwd(g, idx, interpret=False):
     g, c = _pad_channels(g, cb)
     idx, _ = _pad_channels(idx, cb)
     cp = g.shape[-1]
-    gp = jnp.pad(g, [(0, 0), (1, 1), (1, 1), (0, 0)])
-    ip = jnp.pad(
-        idx, [(0, 0), (1, 1), (1, 1), (0, 0)], constant_values=9
-    )
+    nb = _batch_chunk(n)
     kernel = functools.partial(_bwd_kernel, h=h, w=w)
     out = pl.pallas_call(
         kernel,
-        grid=(n, cp // cb),
-        in_specs=[
-            _spec((1, h + 2, w + 2, cb)),
-            _spec((1, h + 2, w + 2, cb)),
-        ],
-        out_specs=_spec((1, h, w, cb)),
+        grid=(n // nb, cp // cb),
+        in_specs=[_spec((nb, h, w, cb)), _spec((nb, h, w, cb))],
+        out_specs=_spec((nb, h, w, cb)),
         out_shape=jax.ShapeDtypeStruct((n, h, w, cp), g.dtype),
         interpret=interpret,
-    )(gp, ip)
+    )(g, idx)
     return out[..., :c]
 
 

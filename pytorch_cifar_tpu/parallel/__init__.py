@@ -12,7 +12,9 @@ from pytorch_cifar_tpu.parallel.mesh import (
 )
 from pytorch_cifar_tpu.parallel.dp import (
     batch_sharding,
+    data_parallel_eval_epoch,
     data_parallel_eval_step,
+    data_parallel_train_epoch,
     data_parallel_train_step,
     replicate,
     unreplicate,
@@ -22,7 +24,9 @@ from pytorch_cifar_tpu.parallel.spatial import (
     make_2d_mesh,
     put_spatial,
     spatial_batch_sharding,
+    spatial_eval_epoch,
     spatial_eval_step,
     spatial_label_sharding,
+    spatial_train_epoch,
     spatial_train_step,
 )

@@ -87,3 +87,46 @@ def data_parallel_eval_step(
         check_vma=False,
     )
     return jax.jit(mapped, compiler_options=tpu_compiler_options(mesh.devices.flat[0]))
+
+
+def data_parallel_train_epoch(
+    epoch_fn: Callable, mesh: Mesh, donate: bool = True
+) -> Callable:
+    """SPMD-wrap a whole-epoch scan (``make_train_epoch(axis_name=...)``).
+
+    Every input is replicated (P()): the device-resident dataset and the
+    epoch permutation are whole-copies on each device, and each shard
+    carves out its own batch rows by ``axis_index`` INSIDE the scan body —
+    there is no per-step host involvement at all, which is the point
+    (one dispatch per epoch; see make_train_epoch).
+    """
+    from pytorch_cifar_tpu import tpu_compiler_options
+
+    mapped = shard_map(
+        epoch_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(
+        mapped,
+        donate_argnums=(0, 1) if donate else (),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+    )
+
+
+def data_parallel_eval_epoch(epoch_fn: Callable, mesh: Mesh) -> Callable:
+    """SPMD-wrap a whole-epoch eval scan (``make_eval_epoch``)."""
+    from pytorch_cifar_tpu import tpu_compiler_options
+
+    mapped = shard_map(
+        epoch_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(
+        mapped, compiler_options=tpu_compiler_options(mesh.devices.flat[0])
+    )

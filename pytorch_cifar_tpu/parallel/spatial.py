@@ -102,6 +102,41 @@ def spatial_eval_step(step_fn: Callable, mesh: Mesh):
     )
 
 
+def spatial_train_epoch(epoch_fn: Callable, mesh: Mesh, donate: bool = True):
+    """jit a GLOBAL-semantics whole-epoch scan over the 2-D mesh.
+
+    Inputs (state, totals, dataset, perm, rng) are all replicated; the
+    scan body materializes each global batch on device and pins its
+    (data x spatial) layout via with_sharding_constraint (built into
+    make_train_epoch through ``batch_sharding=``), from which GSPMD
+    derives the halo exchanges and reductions exactly as in
+    spatial_train_step — but with one dispatch per epoch instead of per
+    step (see make_train_epoch for the measured dispatch economics).
+    """
+    from pytorch_cifar_tpu import tpu_compiler_options
+
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        epoch_fn,
+        in_shardings=(replicated,) * 6,
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0, 1) if donate else (),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+    )
+
+
+def spatial_eval_epoch(epoch_fn: Callable, mesh: Mesh):
+    from pytorch_cifar_tpu import tpu_compiler_options
+
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        epoch_fn,
+        in_shardings=(replicated,) * 3,
+        out_shardings=replicated,
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+    )
+
+
 def put_spatial(x, y, mesh: Mesh) -> Tuple[jax.Array, jax.Array]:
     """Place a host batch onto the 2-D mesh (single-process path)."""
     return (

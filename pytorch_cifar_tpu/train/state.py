@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
-from flax.core import FrozenDict
+from flax.core import unfreeze
 
 
 class TrainState(struct.PyTreeNode):
@@ -41,8 +41,11 @@ def create_train_state(
     model, rng: jax.Array, tx: optax.GradientTransformation, input_shape=(1, 32, 32, 3)
 ) -> TrainState:
     variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", FrozenDict())
+    # plain dicts throughout: model.apply's mutated collections come back as
+    # plain dicts, and a FrozenDict-in/dict-out carry would break pytree
+    # type stability under lax.scan (the epoch-compiled path)
+    params = unfreeze(variables["params"])
+    batch_stats = unfreeze(variables.get("batch_stats", {}))
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

@@ -20,20 +20,29 @@ import jax.numpy as jnp
 
 from pytorch_cifar_tpu.config import TrainConfig
 from pytorch_cifar_tpu.data.cifar10 import load_cifar10, synthetic_cifar10
-from pytorch_cifar_tpu.data.pipeline import Dataloader, eval_batches, put_global
+from pytorch_cifar_tpu.data.pipeline import (
+    Dataloader,
+    DeviceDataset,
+    eval_batches,
+    put_global,
+)
 from pytorch_cifar_tpu.models import create_model
 from pytorch_cifar_tpu.parallel import (
     DATA_AXIS,
     batch_sharding,
+    data_parallel_eval_epoch,
     data_parallel_eval_step,
+    data_parallel_train_epoch,
     data_parallel_train_step,
     initialize_distributed,
     make_2d_mesh,
     make_mesh,
     replicate,
     spatial_batch_sharding,
+    spatial_eval_epoch,
     spatial_eval_step,
     spatial_label_sharding,
+    spatial_train_epoch,
     spatial_train_step,
 )
 from pytorch_cifar_tpu.parallel.mesh import is_primary
@@ -46,7 +55,13 @@ from pytorch_cifar_tpu.train.checkpoint import (
 )
 from pytorch_cifar_tpu.train.optim import make_optimizer
 from pytorch_cifar_tpu.train.state import TrainState, create_train_state
-from pytorch_cifar_tpu.train.steps import make_eval_step, make_train_step
+from pytorch_cifar_tpu.train.steps import (
+    make_eval_epoch,
+    make_eval_step,
+    make_train_epoch,
+    make_train_step,
+    zero_metrics,
+)
 from pytorch_cifar_tpu.utils import progress_bar, set_logger
 
 log = logging.getLogger(__name__)
@@ -103,6 +118,16 @@ class Trainer:
         else:
             self.mesh = make_mesh(config.num_devices)
             n_dev = self.mesh.devices.size
+        if (
+            self.mesh.devices.size > 1
+            and self.mesh.devices.flat[0].platform == "cpu"
+        ):
+            # XLA:CPU in-process collectives can deadlock-abort when
+            # several multi-partition executions are in flight at once
+            # (honor_platform_env); serialize dispatch only when a CPU
+            # mesh actually has collectives to deadlock — a single-device
+            # CPU run keeps pipelining
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
         if config.batch_size % n_dev:
             # parity with main_dist.py:112-115's divisibility warning
             log.warning(
@@ -122,6 +147,7 @@ class Trainer:
         # single source of truth for where augmentation runs: host pipeline
         # (native data plane) vs on-device prologue of the train step
         host_aug = config.host_augment and config.random_crop
+        device_data = config.device_data and not host_aug
         if config.evaluate:
             # eval-only: no shuffling/augmenting loader or train step needed;
             # steps_per_epoch (which anchors the LR schedule restored from
@@ -134,6 +160,18 @@ class Trainer:
                 else -(-n // self.global_batch),
                 1,
             )
+        elif device_data:
+            self.loader = DeviceDataset(
+                tr_x,
+                tr_y,
+                batch_size=self.global_batch,
+                shuffle=True,
+                drop_last=config.drop_last,
+                seed=config.seed,
+                sharding=sharding,
+                label_sharding=lbl_sharding,
+            )
+            self.steps_per_epoch = len(self.loader)
         else:
             self.loader = Dataloader(
                 tr_x,
@@ -148,6 +186,22 @@ class Trainer:
                 augment_flip=config.random_flip,
             )
             self.steps_per_epoch = len(self.loader)
+        # eval data stays device-resident too: the test set is static, so
+        # re-transferring it every epoch (the round-1 path) paid the slow
+        # H2D link 200 times for the same 30 MB
+        self.eval_loader = (
+            DeviceDataset(
+                te_x,
+                te_y,
+                batch_size=eval_bs,
+                shuffle=False,
+                drop_last=False,
+                sharding=sharding,
+                label_sharding=lbl_sharding,
+            )
+            if device_data
+            else None
+        )
         self.eval_bs = eval_bs
         self.sharding = sharding
         self.label_sharding = lbl_sharding
@@ -222,21 +276,78 @@ class Trainer:
             # globally exact here, so sync_bn has nothing to add.
             wrap_train = lambda fn: spatial_train_step(fn, self.mesh)
             wrap_eval = lambda fn: spatial_eval_step(fn, self.mesh)
+            wrap_train_epoch = lambda fn: spatial_train_epoch(fn, self.mesh)
+            wrap_eval_epoch = lambda fn: spatial_eval_epoch(fn, self.mesh)
+            epoch_kwargs = dict(
+                batch_sharding=sharding, label_sharding=lbl_sharding
+            )
         else:
             step_kwargs.update(axis_name=DATA_AXIS, sync_bn=config.sync_bn)
             eval_kwargs.update(axis_name=DATA_AXIS)
             wrap_train = lambda fn: data_parallel_train_step(fn, self.mesh)
             wrap_eval = lambda fn: data_parallel_eval_step(fn, self.mesh)
-        self.train_step = (
-            None
-            if config.evaluate
-            else wrap_train(make_train_step(**step_kwargs))
-        )
-        self.eval_step = wrap_eval(make_eval_step(**eval_kwargs))
+            wrap_train_epoch = lambda fn: data_parallel_train_epoch(
+                fn, self.mesh
+            )
+            wrap_eval_epoch = lambda fn: data_parallel_eval_epoch(
+                fn, self.mesh
+            )
+            epoch_kwargs = dict(axis_name=DATA_AXIS, n_shards=n_dev)
+        if device_data:
+            # epoch-compiled path: ONE dispatch per epoch (scan over the
+            # device-resident dataset) — per-step dispatch through a
+            # remote-TPU transport costs more than the compute it launches
+            # (measured ~2 s/epoch of dispatch vs 1.4 s compute;
+            # steps.make_train_epoch). The per-step paths below are not
+            # built at all: each would be a second multi-minute XLA
+            # compile of the same model for no production use.
+            self.train_step = None
+            self.eval_step = None
+            n_eval = te_x.shape[0]
+            eval_steps = max(-(-n_eval // eval_bs), 1)
+            self.train_epoch_fn = (
+                None
+                if config.evaluate
+                else wrap_train_epoch(
+                    make_train_epoch(
+                        make_train_step(**step_kwargs),
+                        global_batch=self.global_batch,
+                        n_data=tr_x.shape[0],
+                        num_steps=self.steps_per_epoch,
+                        **epoch_kwargs,
+                    )
+                )
+            )
+            self.eval_epoch_fn = wrap_eval_epoch(
+                make_eval_epoch(
+                    make_eval_step(**eval_kwargs),
+                    global_batch=eval_bs,
+                    n_data=n_eval,
+                    num_steps=eval_steps,
+                    **epoch_kwargs,
+                )
+            )
+        else:
+            self.train_epoch_fn = None
+            self.eval_epoch_fn = None
+            self.train_step = (
+                None
+                if config.evaluate
+                else wrap_train(make_train_step(**step_kwargs))
+            )
+            self.eval_step = wrap_eval(make_eval_step(**eval_kwargs))
         self.rng = jax.random.PRNGKey(config.seed + 1)
         self._trace_dir = None  # set by fit() for the profiled epoch
         self.profile_steps = 20
         self._stop_requested = False
+        # async best-checkpoint machinery: device-side snapshot + writer
+        # thread (see maybe_checkpoint)
+        self._copy_state = jax.jit(
+            lambda s: jax.tree_util.tree_map(jnp.copy, s)
+        )
+        self._snapshot = None  # (state copy, epoch, best_acc)
+        self._save_thread = None
+        self._written_epoch = None
 
     # ------------------------------------------------------------------
 
@@ -260,6 +371,8 @@ class Trainer:
         return [CKPT_NAME, LAST_NAME]
 
     def train_epoch(self, epoch: int) -> Tuple[float, float]:
+        if self.train_epoch_fn is not None:
+            return self._train_epoch_compiled(epoch)
         if self.train_step is None:
             raise RuntimeError(
                 "Trainer was built with evaluate=True; training is disabled"
@@ -337,6 +450,62 @@ class Trainer:
         )
         return loss_sum / max(count, 1), 100.0 * correct / max(count, 1)
 
+    def _train_epoch_compiled(self, epoch: int) -> Tuple[float, float]:
+        """One-dispatch epoch over the device-resident dataset.
+
+        Host involvement per epoch: one ~200 KB permutation upload, one
+        dispatch, one 12-byte metric fetch. No per-step progress is
+        observable (the whole epoch is a single XLA computation — ~1.4 s
+        for the flagship), so the bar renders once per epoch.
+        """
+        if self.train_epoch_fn is None:
+            raise RuntimeError(
+                "Trainer was built with evaluate=True; training is disabled"
+            )
+        log.info("\nEpoch: %d", epoch)
+        nb = self.steps_per_epoch
+        rng = jax.random.fold_in(self.rng, epoch)
+        perm = self.loader.staged_perm(epoch)
+        t0 = time.time()
+        if self._trace_dir:
+            jax.profiler.start_trace(self._trace_dir)
+        self.state, totals = self.train_epoch_fn(
+            self.state,
+            zero_metrics(),
+            self.loader.images,
+            self.loader.labels,
+            perm,
+            rng,
+        )
+        m = jax.device_get(totals)  # the one sync of the epoch
+        if self._trace_dir:
+            jax.profiler.stop_trace()
+        dt = time.time() - t0
+        loss_sum = float(m["loss_sum"])
+        correct = float(m["correct"])
+        count = float(m["count"])
+        if is_primary():
+            progress_bar(
+                nb - 1,
+                nb,
+                "Loss: %.3f | Acc: %.3f%% (%d/%d)"
+                % (
+                    loss_sum / max(count, 1),
+                    100.0 * correct / max(count, 1),
+                    int(correct),
+                    int(count),
+                ),
+                log_every=self.config.log_every,
+            )
+        log.info(
+            "train epoch %d: loss %.4f acc %.2f%% (%.0f img/s)",
+            epoch,
+            loss_sum / max(count, 1),
+            100.0 * correct / max(count, 1),
+            count / max(dt, 1e-9),
+        )
+        return loss_sum / max(count, 1), 100.0 * correct / max(count, 1)
+
     def eval_epoch(self, epoch: int) -> Tuple[float, float]:
         # Accumulate the psum'd per-batch metrics ON DEVICE and fetch once:
         # a per-batch device_get would cost one blocking D2H round-trip per
@@ -344,18 +513,29 @@ class Trainer:
         # same trap), which through a remote-TPU transport dominates the
         # eval epoch. All batches dispatch async; the single fetch at the
         # end drains the queue.
-        totals = None
-        for x, y in eval_batches(
-            self.test_images, self.test_labels, self.eval_bs
-        ):
-            batch = put_global(x, y, self.sharding, self.label_sharding)
-            m = self.eval_step(self.state, batch)
-            totals = (
-                m
-                if totals is None
-                else jax.tree_util.tree_map(jnp.add, totals, m)
+        if self.eval_epoch_fn is not None:
+            # device-resident test set, whole eval in one dispatch: zero
+            # H2D per epoch, one D2H metric fetch
+            m = jax.device_get(
+                self.eval_epoch_fn(
+                    self.state,
+                    self.eval_loader.images,
+                    self.eval_loader.labels,
+                )
             )
-        m = jax.device_get(totals)
+        else:
+            totals = None
+            for x, y in eval_batches(
+                self.test_images, self.test_labels, self.eval_bs
+            ):
+                batch = put_global(x, y, self.sharding, self.label_sharding)
+                mm = self.eval_step(self.state, batch)
+                totals = (
+                    mm
+                    if totals is None
+                    else jax.tree_util.tree_map(jnp.add, totals, mm)
+                )
+            m = jax.device_get(totals)
         loss_sum = float(m["loss_sum"])
         correct = float(m["correct"])
         count = float(m["count"])
@@ -369,14 +549,74 @@ class Trainer:
         return loss_sum / max(count, 1), acc
 
     def maybe_checkpoint(self, epoch: int, acc: float) -> bool:
+        """Best-accuracy checkpoint gate (reference semantics,
+        main.py:136-148) — but the disk write is decoupled from the
+        training loop: the best state is snapshotted on DEVICE (a
+        device-to-device copy, microseconds) and streamed to disk by a
+        background thread. Through a slow host transport the synchronous
+        alternative — ~100 MB of device_get at ~7.5 MB/s — costs ~14 s,
+        ten times the epoch it interrupts (measured; BENCHMARKS.md).
+        ``flush_checkpoints`` (called by fit) guarantees the newest
+        snapshot is on disk before the run ends."""
         if acc > self.best_acc:
             self.best_acc = acc
             log.info("Saving.. (best acc %.2f%%)", acc)
-            save_checkpoint(
-                self.config.output_dir, self.state, epoch, self.best_acc
+            if not self.config.async_checkpoint:
+                save_checkpoint(
+                    self.config.output_dir, self.state, epoch, self.best_acc
+                )
+                return True
+            self._snapshot = (
+                self._copy_state(self.state),
+                epoch,
+                self.best_acc,
             )
+            self._kick_async_save()
             return True
         return False
+
+    def _kick_async_save(self) -> None:
+        import threading
+
+        if self._save_thread is not None and self._save_thread.is_alive():
+            # a write is in flight; flush_checkpoints picks up this newer
+            # snapshot later (or the next kick does)
+            return
+        snap = self._snapshot
+        if snap is None or snap[1] == self._written_epoch:
+            return
+
+        def work():
+            # _written_epoch is only advanced on SUCCESS: a failed write
+            # (disk full, dir deleted) is logged here and retried
+            # synchronously by flush_checkpoints — which then propagates
+            # the error instead of reporting a phantom checkpoint
+            try:
+                save_checkpoint(
+                    self.config.output_dir, snap[0], snap[1], snap[2]
+                )
+                self._written_epoch = snap[1]
+            except Exception:
+                log.exception(
+                    "async checkpoint write failed (epoch %d)", snap[1]
+                )
+
+        self._save_thread = threading.Thread(
+            target=work, name="ckpt-writer", daemon=True
+        )
+        self._save_thread.start()
+
+    def flush_checkpoints(self) -> None:
+        """Block until the newest best-state snapshot is on disk. A write
+        that failed in the background is retried here synchronously, so
+        persistent failures raise instead of vanishing."""
+        t = self._save_thread
+        if t is not None:
+            t.join()
+        snap = self._snapshot
+        if snap is not None and snap[1] != self._written_epoch:
+            save_checkpoint(self.config.output_dir, snap[0], snap[1], snap[2])
+            self._written_epoch = snap[1]
 
     def fit(self) -> float:
         cfg = self.config
@@ -444,6 +684,9 @@ class Trainer:
                         except OSError:
                             pass
         finally:
+            # the newest best-state snapshot must be on disk before the
+            # process can exit (async writer, maybe_checkpoint)
+            self.flush_checkpoints()
             if old_handler is not None:
                 signal.signal(signal.SIGTERM, old_handler)
         return self.best_acc

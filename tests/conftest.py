@@ -10,9 +10,19 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "collective_call_terminate" not in flags:
+    # 8 partition threads interleave on however few cores CI gives us (this
+    # VM: ONE) — a straggler partition can legitimately take minutes to
+    # reach an all-reduce while its peers spin. XLA's default 40 s
+    # rendezvous termination then abort()s the whole process (observed:
+    # "Fatal Python error: Aborted" mid-suite). These are liveness
+    # timeouts, not correctness ones — raise them far past any real test.
+    flags += (
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=300"
+    )
+os.environ["XLA_FLAGS"] = flags
 
 # A site-installed TPU plugin may override jax_platforms in jax.config at
 # interpreter startup (ignoring the env var), which would make every test
@@ -20,7 +30,9 @@ if "xla_force_host_platform_device_count" not in flags:
 # level before any backend is initialized (canonical helper).
 from pytorch_cifar_tpu import honor_platform_env  # noqa: E402
 
-honor_platform_env()
+honor_platform_env()  # also serializes CPU dispatch: XLA:CPU's in-process
+# collective rendezvous can deadlock (and abort after 40 s) when multiple
+# 8-partition executions run concurrently — see honor_platform_env
 
 import jax  # noqa: E402
 

@@ -77,6 +77,35 @@ def test_train_epoch_covers_every_image(tmp_path):
     assert np.isfinite(loss)
 
 
+def test_epoch_compiled_matches_step_loop(tmp_path):
+    """The one-dispatch epoch scan (device_data=True, the production path)
+    must produce the same training result as the per-step host-loader loop:
+    same permutation, same augmentation stream (keys fold state.step +
+    axis_index identically), same wrap-pad masking — so the two paths are
+    interchangeable and the dispatch optimization can never change a
+    trajectory. Ragged batch included (512 % 96 != 0)."""
+    cfg_dev = small_config(
+        tmp_path / "dev", epochs=1, batch_size=96, device_data=True
+    )
+    cfg_host = small_config(
+        tmp_path / "host", epochs=1, batch_size=96, device_data=False
+    )
+    tr_dev, tr_host = Trainer(cfg_dev), Trainer(cfg_host)
+    loss_dev, acc_dev = tr_dev.train_epoch(0)
+    loss_host, acc_host = tr_host.train_epoch(0)
+    assert loss_dev == pytest.approx(loss_host, rel=1e-5)
+    assert acc_dev == pytest.approx(acc_host, abs=1e-6)
+    p1 = jax.tree_util.tree_leaves(jax.device_get(tr_dev.state.params))
+    p2 = jax.tree_util.tree_leaves(jax.device_get(tr_host.state.params))
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    # and the two eval paths agree on the result
+    el_dev, ea_dev = tr_dev.eval_epoch(0)
+    el_host, ea_host = tr_host.eval_epoch(0)
+    assert ea_dev == pytest.approx(ea_host, abs=1e-6)
+    assert el_dev == pytest.approx(el_host, rel=1e-5)
+
+
 def test_fit_trains_and_checkpoints(tmp_path):
     cfg = small_config(tmp_path)
     trainer = Trainer(cfg)
@@ -86,6 +115,7 @@ def test_fit_trains_and_checkpoints(tmp_path):
     assert second_loss < first_loss
     _, acc = trainer.eval_epoch(1)
     assert trainer.maybe_checkpoint(1, acc)
+    trainer.flush_checkpoints()  # async writer: fit() flushes; direct callers must too
     assert os.path.isfile(os.path.join(cfg.output_dir, "ckpt.msgpack"))
     meta = json.load(open(os.path.join(cfg.output_dir, "ckpt.json")))
     assert meta["epoch"] == 1
@@ -100,6 +130,7 @@ def test_resume_restores_exact_state(tmp_path):
     t1.train_epoch(0)
     _, acc = t1.eval_epoch(0)
     t1.maybe_checkpoint(0, acc)
+    t1.flush_checkpoints()
 
     cfg2 = small_config(tmp_path, epochs=2, resume=True)
     t2 = Trainer(cfg2)
@@ -120,6 +151,38 @@ def test_resume_restores_exact_state(tmp_path):
     assert int(t2.state.step) == int(t1.state.step)
 
 
+def test_async_checkpoint_snapshot_survives_later_training(tmp_path):
+    """The device-side best-state snapshot must hold its own buffers: the
+    live state is DONATED into the next epoch's dispatch, so an aliased
+    snapshot would be invalidated (or silently overwritten). Training past
+    the snapshot and then flushing must write the snapshot-time params."""
+    # epochs=3: the cosine schedule must still have lr > 0 for the
+    # post-snapshot epoch, else params legitimately stop moving and the
+    # divergence assertion below is vacuous (lr hits 0 at T_max)
+    cfg = small_config(tmp_path, epochs=3)
+    tr = Trainer(cfg)
+    tr.train_epoch(0)
+    _, acc = tr.eval_epoch(0)
+    assert tr.maybe_checkpoint(0, acc)
+    snap = jax.device_get(tr._snapshot[0].params)
+    tr.train_epoch(1)  # donates/mutates the live state
+    tr.flush_checkpoints()
+
+    cfg2 = small_config(tmp_path, epochs=2, resume=True)
+    t2 = Trainer(cfg2)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(snap),
+        jax.tree_util.tree_leaves(jax.device_get(t2.state.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the later (post-snapshot) live params differ from the snapshot
+    later = jax.tree_util.tree_leaves(jax.device_get(tr.state.params))
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(snap), later)
+    )
+
+
 def test_evaluate_only_mode(tmp_path):
     """--evaluate loads the checkpoint and reports eval accuracy without
     training (extends the reference, which has no eval-only path)."""
@@ -128,6 +191,7 @@ def test_evaluate_only_mode(tmp_path):
     t1.train_epoch(0)
     _, acc = t1.eval_epoch(0)
     t1.maybe_checkpoint(0, acc)
+    t1.flush_checkpoints()
 
     cfg2 = small_config(tmp_path, evaluate=True)
     t2 = Trainer(cfg2)

@@ -116,7 +116,7 @@ def main() -> int:
         _write_summary(
             args, cfg, history, epochs_to_target, t0, t_first_step, trainer
         )
-    wall = time.time() - t0
+    trainer.flush_checkpoints()  # async best-state writer (trainer.py)
     summary = _write_summary(
         args, cfg, history, epochs_to_target, t0, t_first_step, trainer
     )
