@@ -68,6 +68,11 @@ class TrainConfig:
     # rematerialize the forward during backward (jax.checkpoint): trades
     # ~30% step time for activation memory, unlocking batch sizes past HBM
     remat: bool = False
+    # compute narrow-group convs (1 < channels/group <= 16) as
+    # block-diagonal dense convs: redundant FLOPs buy back MXU lanes.
+    # Numerically identical; measured +6% on ResNeXt29_32x4d (v5e).
+    # Off by default — only the narrow-group ResNeXt family benefits.
+    dense_grouped_conv: bool = False
 
     # parallelism
     num_devices: int = 0  # 0 = all local devices, data-parallel mesh

@@ -107,6 +107,90 @@ def torch_linear_bias_init(in_features: int):
 # ---------------------------------------------------------------------------
 
 
+# Trace-time switch: compute grouped convs as block-diagonal DENSE convs.
+# Narrow channel groups (ResNeXt's 32 groups of 4-16 channels) starve the
+# 128-wide MXU lanes under the native grouped lowering; expanding the
+# kernel to a zero-padded dense one spends redundant FLOPs to reclaim
+# lanes. Numerically identical (the extra terms are exact zeros).
+# Measured on the v5e (BENCHMARKS.md round 2): ResNeXt29_32x4d
+# 6.9k -> 7.4k img/s (+6%); DEPTHWISE convs (channels-per-group 1,
+# PNASNet/MobileNet) are 14x WORSE dense (12.7k -> 0.9k) — the FLOP
+# explosion dwarfs the lane recovery — so the gate below excludes them.
+_DENSE_GROUPED: contextvars.ContextVar = contextvars.ContextVar(
+    "dense_grouped_conv", default=False
+)
+
+
+@contextlib.contextmanager
+def dense_grouped_conv(enable: bool = True):
+    token = _DENSE_GROUPED.set(enable)
+    try:
+        yield
+    finally:
+        _DENSE_GROUPED.reset(token)
+
+
+def set_dense_grouped_conv(enable: bool) -> None:
+    """Non-scoped setter for long-lived processes (the Trainer sets this
+    from --dense_grouped_conv BEFORE any step is traced; jit traces lazily
+    at first call, so a with-block around step construction would not
+    cover the actual trace)."""
+    _DENSE_GROUPED.set(enable)
+
+
+class _TorchGroupedConv(nn.Conv):
+    """nn.Conv whose grouped path can expand to a block-diagonal dense conv.
+
+    Same parameter name/shape/init as nn.Conv (the module is instantiated
+    with an explicit ``name`` so the param tree is identical either way);
+    only the computation changes under ``dense_grouped_conv()``.
+    """
+
+    @nn.compact
+    def __call__(self, x):
+        g = self.feature_group_count
+        cin = x.shape[-1]
+        cpg = cin // g
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (kh, kw, cpg, self.features),
+            self.param_dtype,
+        )
+        bias = (
+            self.param(
+                "bias", self.bias_init, (self.features,), self.param_dtype
+            )
+            if self.use_bias
+            else None
+        )
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype
+        )
+        if _DENSE_GROUPED.get() and g > 1 and 1 < cpg <= 16:
+            # block-diagonal expansion: dense[ky,kx, h*cpg+r, j*opg+o] =
+            # kernel[ky,kx,r,j*opg+o] iff h == j (torch group layout:
+            # group-major channel order on both sides)
+            opg = self.features // g
+            w5 = kernel.reshape(kh, kw, cpg, g, opg)
+            eye = jnp.eye(g, dtype=kernel.dtype)
+            dense = jnp.einsum("xyrgo,hg->xyhrgo", w5, eye)
+            kernel = dense.reshape(kh, kw, cin, g * opg)
+            g = 1
+        out = jax.lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=self.strides,
+            padding=list(self.padding),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=g,
+        )
+        if bias is not None:
+            out = out + bias
+        return out
+
+
 class Conv(nn.Module):
     """2D conv with PyTorch-default init and PyTorch-style int padding.
 
@@ -131,7 +215,7 @@ class Conv(nn.Module):
         )
         in_ch = x.shape[-1]
         fan_in = ks[0] * ks[1] * (in_ch // self.groups)
-        return nn.Conv(
+        return _TorchGroupedConv(
             features=self.features,
             kernel_size=ks,
             strides=(self.strides, self.strides),
@@ -142,6 +226,7 @@ class Conv(nn.Module):
             bias_init=torch_conv_bias_init(fan_in),
             dtype=self.dtype,
             param_dtype=jnp.float32,
+            name="Conv_0",  # keep the nn.Conv param path: .../Conv_0/kernel
         )(x)
 
 
@@ -162,6 +247,24 @@ class Dense(nn.Module):
             dtype=self.dtype,
             param_dtype=jnp.float32,
         )(x)
+
+
+# Pluggable batch-moments implementation: fn(x) -> (E[x], E[x^2]) in fp32.
+# None -> the inline twin-reduce below. Experiment hook for fused Pallas
+# moment kernels (ops/bn_stats.py, tools/bn_bench.py) — a trace-time switch
+# like sync_batchnorm, so no model file changes.
+_BN_MOMENTS_IMPL: contextvars.ContextVar = contextvars.ContextVar(
+    "bn_moments_impl", default=None
+)
+
+
+@contextlib.contextmanager
+def bn_moments_impl(fn):
+    token = _BN_MOMENTS_IMPL.set(fn)
+    try:
+        yield
+    finally:
+        _BN_MOMENTS_IMPL.reset(token)
 
 
 class BatchNorm(nn.Module):
@@ -212,9 +315,13 @@ class BatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
         else:
             axes = tuple(range(x.ndim - 1))
-            xf = x.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=axes)
-            sq = jnp.mean(jnp.square(xf), axis=axes)
+            moments = _BN_MOMENTS_IMPL.get()
+            if moments is not None and not self.is_initializing():
+                mean, sq = moments(x)
+            else:
+                xf = x.astype(jnp.float32)
+                mean = jnp.mean(xf, axis=axes)
+                sq = jnp.mean(jnp.square(xf), axis=axes)
             world = 1
             sync_axis = _SYNC_BN_AXIS.get()
             if sync_axis is not None and not self.is_initializing():

@@ -1,0 +1,39 @@
+"""Shared VMEM-blocking helpers for the Pallas kernels in this package.
+
+One home for the grid/block sizing rules so sibling kernels cannot drift
+(ops/max_pool.py, ops/bn_stats.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def channel_chunk(c: int) -> int:
+    """Channel block: 128 matches the TPU lane width; small channel counts
+    run whole."""
+    return c if c <= 128 else 128
+
+
+def batch_chunk(n: int, max_nb: int = 8) -> int:
+    """Images per program: the largest divisor of ``n`` up to ``max_nb``.
+    8 amortizes grid overhead without stressing VMEM at (8,32,32,128)
+    blocks. Kernels with 4-D i1 masks must pass max_nb=1 (Mosaic rejects
+    their relayouts — see ops/max_pool.py)."""
+    for nb in (8, 4, 2, 1):
+        if nb <= max_nb and n % nb == 0:
+            return nb
+    return 1
+
+
+def pad_channels(a, cb: int):
+    """Zero-pad the channel (last) axis up to a multiple of ``cb``.
+    Returns (padded, original_channels)."""
+    c = a.shape[-1]
+    if c % cb == 0:
+        return a, c
+    cpad = -(-c // cb) * cb
+    return (
+        jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, cpad - c)]),
+        c,
+    )

@@ -1,0 +1,99 @@
+"""Pallas TPU kernel: one-pass per-channel batch moments (E[x], E[x^2]).
+
+Target: the ResNet18 step's largest non-conv cost — BatchNorm batch-
+statistics and BN-gradient reductions, profiled at ~35% of the step
+(multiply_reduce fusions, BENCHMARKS.md). The forward moments are two
+full reads of every activation tensor if XLA materializes them as separate
+reductions; this kernel computes both sums in ONE pass (read x once, emit
+(sum, sum_sq) per channel), with an elementwise custom VJP
+(d/dx [a.sum(x) + b.sum(x^2)] = a + 2 b x) that fuses into neighboring
+elementwise work.
+
+Wired into models.common.BatchNorm only if the on-chip A/B
+(tools/bn_bench.py) beats XLA's twin-reduce — see BENCHMARKS.md for the
+measured verdict.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_cifar_tpu.ops.blocking import batch_chunk, channel_chunk, pad_channels
+
+
+def _moments_kernel(x_ref, out_ref):
+    # x_ref: (nb, h, w, cb) block; out_ref: (2, cb) running (sum, sum_sq).
+    # The batch dimension is the INNERMOST grid dim: Pallas only preserves
+    # a revisited output block's contents across CONSECUTIVE grid steps,
+    # so the accumulation dim must iterate fastest. (With it outermost,
+    # c > 2 blocks cycles the double buffers and the accumulator reads
+    # stale data — exactly the wrong-answer-at-c=512 bug this had.)
+    i = pl.program_id(1)
+    xf = x_ref[...].astype(jnp.float32)
+    flat = xf.reshape(-1, xf.shape[-1])
+    s1 = jnp.sum(flat, axis=0)
+    s2 = jnp.sum(jnp.square(flat), axis=0)
+    block = jnp.stack([s1, s2])
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = block
+
+    @pl.when(i > 0)
+    def _acc():
+        out_ref[...] = out_ref[...] + block
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _moments_sums(x, interpret=False):
+    n, h, w, c = x.shape
+    cb = channel_chunk(c)
+    x, c = pad_channels(x, cb)
+    cp = x.shape[-1]
+    nb = batch_chunk(n)
+    out = pl.pallas_call(
+        _moments_kernel,
+        grid=(cp // cb, n // nb),  # batch innermost: see _moments_kernel
+        in_specs=[
+            pl.BlockSpec(
+                (nb, h, w, cb),
+                lambda j, i: (i, 0, 0, j),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (2, cb), lambda j, i: (0, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((2, cp), jnp.float32),
+        interpret=interpret,
+    )(x)
+    return out[:, :c]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def fused_moments(x, interpret: bool = False):
+    """(E[x], E[x^2]) over all but the channel axis, fp32, one pass."""
+    sums = _moments_sums(x, interpret=interpret)
+    n = x.shape[0] * x.shape[1] * x.shape[2]
+    return sums[0] / n, sums[1] / n
+
+
+def _vjp_fwd(x, interpret):
+    return fused_moments(x, interpret), x
+
+
+def _vjp_bwd(interpret, x, cts):
+    a, b = cts  # cotangents of (mean, mean_sq)
+    n = x.shape[0] * x.shape[1] * x.shape[2]
+    # d mean/dx = 1/n ; d mean_sq/dx = 2x/n — a per-channel FMA that XLA
+    # fuses into adjacent elementwise work (no reduction in the backward)
+    dx = (a / n) + x.astype(jnp.float32) * (2.0 * b / n)
+    return (dx.astype(x.dtype),)
+
+
+fused_moments.defvjp(_vjp_fwd, _vjp_bwd)

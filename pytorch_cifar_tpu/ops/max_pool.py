@@ -14,12 +14,19 @@ select-and-scatter and cuDNN's MaxPoolGrad). The backward then becomes nine
 masked accumulations over VMEM-resident tiles — shifted reads of a tile
 already in VMEM are register traffic, not misaligned HBM loads.
 
-Status: NOT yet wired into the model zoo — ``models.common.max_pool``
-still dispatches to ``nn.max_pool`` (XLA select-and-scatter backward,
-12.0 ms at the GoogLeNet shape); it switches over only if the on-chip
-A/B below lands faster. Correctness is pinned either way by
-``tests/test_ops.py`` (interpret-mode exact fp32 gradient equality with
-select-and-scatter).
+Status: NOT wired into the model zoo — ``models.common.max_pool`` stays
+on ``nn.max_pool``. Round-2 A/B on the v5e (``tools/pool_bench.py``,
+chained-call + D2H-sync protocol, (512,32,32,480) bf16 fwd+bwd):
+**Pallas 22.2 ms vs XLA select-and-scatter 11.0 ms** — the rewrite
+recovered 16 ms over round 1's 38.1 ms (HBM pre-pads + int32 map
+eliminated) but the body is VPU-bound: every shifted W-slice of the
+VMEM-padded (34,34) tile is a sublane-misaligned read, and Mosaic
+rejects both bf16 compares ("Target does not support this comparison")
+and mixed-dtype masks, forcing f32 widening. Channel-block sweep
+128/256/512 is within noise, confirming compute-bound. Correctness is
+pinned by ``tests/test_ops.py`` (interpret-mode exact fp32 gradient
+equality with select-and-scatter) so future Mosaic work starts from a
+correct 22 ms baseline, 2x from parity.
 
 Round-2 rewrite (vs the round-1 version measured at 38.1 ms against XLA's
 12.0 ms at (512,32,32,480) bf16 fwd+bwd):
@@ -27,11 +34,13 @@ Round-2 rewrite (vs the round-1 version measured at 38.1 ms against XLA's
   backward both g and the index map) to (N,34,34,C) in HBM — three extra
   full-tensor copies through the bandwidth roof. Padding now happens on
   the VMEM tile inside the kernel.
-- int8 winner map (was int32): 4x less index traffic in both directions.
-- native-dtype compare chain (was fp32-widened): bf16 max/compare is
-  exact for bf16 inputs; no conversion passes.
-- batch-blocked grid (8 images per program instead of 1): fewer grid
-  steps, deeper DMA pipelining.
+- input-dtype winner map (was int32): 2x less index traffic in bf16, and
+  — the real constraint — a SINGLE dtype family inside the kernel. Mixed
+  families (bf16 compares feeding int8 selects) die in Mosaic with
+  "Invalid relayout ... xi1: (16,128) -> (32,128)"; int8 would need its
+  own (32,128) mask layout.
+- f32 compute stays (Mosaic rejects bf16 compares on this target), but
+  only in registers — HBM loads/stores remain in the input dtype.
 """
 
 from __future__ import annotations
@@ -43,6 +52,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from pytorch_cifar_tpu.ops.blocking import batch_chunk, channel_chunk, pad_channels
+
 _NEG = float("-inf")
 
 
@@ -50,22 +61,34 @@ def _fwd_kernel(x_ref, out_ref, idx_ref=None, *, h, w):
     # x_ref: (nb, h, w, c) unpadded input tile; out/idx: (nb, h, w, c).
     # idx_ref is None for the forward-only (inference) variant — the winner
     # map is only needed to route gradients.
-    x = x_ref[...]
+    #
+    # The winner map is kept in the INPUT dtype (0..8 are exact in bf16):
+    # mixing dtype families inside the kernel (bf16 compares driving int8
+    # selects) produces i1 masks in incompatible Mosaic layouts —
+    # "Invalid relayout ... xi1: (16,128) -> (32,128)" — while a single
+    # dtype family keeps every mask/select in one layout.
+    # f32 in-register compute: Mosaic rejects bf16 compares on this target
+    # ("Target does not support this comparison"); the conversions are VPU
+    # register traffic, while loads/stores stay in the input dtype so the
+    # HBM side keeps the bandwidth win.
+    x = x_ref[...].astype(jnp.float32)
     xp = jnp.pad(
         x, [(0, 0), (1, 1), (1, 1), (0, 0)], constant_values=_NEG
     )  # VMEM-local halo, not an HBM copy
     best = xp[:, 0:h, 0:w, :]
-    idx = jnp.zeros(best.shape, jnp.int8) if idx_ref is not None else None
+    idx = (
+        jnp.zeros(best.shape, jnp.float32) if idx_ref is not None else None
+    )
     for k in range(1, 9):
         ky, kx = divmod(k, 3)
         cur = xp[:, ky : ky + h, kx : kx + w, :]
         m = cur > best  # strict: earlier (row-major) tap keeps ties
         if idx_ref is not None:
-            idx = jnp.where(m, jnp.int8(k), idx)
+            idx = jnp.where(m, jnp.float32(k), idx)
         best = jnp.where(m, cur, best)
     out_ref[...] = best.astype(out_ref.dtype)
     if idx_ref is not None:
-        idx_ref[...] = idx
+        idx_ref[...] = idx.astype(idx_ref.dtype)
 
 
 def _bwd_kernel(g_ref, i_ref, gi_ref, *, h, w):
@@ -74,21 +97,21 @@ def _bwd_kernel(g_ref, i_ref, gi_ref, *, h, w):
     # window's winner index equals k: gi[p] = sum_k [i'[k] == k] * g'[k]
     # with the shifted slice [2-ky : 2-ky+h, 2-kx : 2-kx+w] of the
     # VMEM-padded tiles (pad value 9 can never match a real tap index).
-    gp = jnp.pad(g_ref[...], [(0, 0), (1, 1), (1, 1), (0, 0)])
+    g = g_ref[...].astype(jnp.float32)
+    gp = jnp.pad(g, [(0, 0), (1, 1), (1, 1), (0, 0)])
     ip = jnp.pad(
-        i_ref[...], [(0, 0), (1, 1), (1, 1), (0, 0)],
-        constant_values=jnp.int8(9),
+        i_ref[...].astype(jnp.float32),
+        [(0, 0), (1, 1), (1, 1), (0, 0)],
+        constant_values=9.0,
     )
-    nb = gp.shape[0]
-    acc = jnp.zeros((nb, h, w, gi_ref.shape[-1]), jnp.float32)
+    acc = None
     for k in range(9):
         ky, kx = divmod(k, 3)
         sl_h = slice(2 - ky, 2 - ky + h)
         sl_w = slice(2 - kx, 2 - kx + w)
-        hit = ip[:, sl_h, sl_w, :] == k
-        acc = acc + jnp.where(hit, gp[:, sl_h, sl_w, :], 0).astype(
-            jnp.float32
-        )
+        hit = ip[:, sl_h, sl_w, :] == jnp.float32(k)
+        term = jnp.where(hit, gp[:, sl_h, sl_w, :], jnp.float32(0))
+        acc = term if acc is None else acc + term
     gi_ref[...] = acc.astype(gi_ref.dtype)
 
 
@@ -99,26 +122,20 @@ def _spec(shape):
 
 
 def _chunk(c: int) -> int:
-    """Channel block: 128 matches the lane width; small channel counts run
-    whole."""
-    return c if c <= 128 else 128
+    """Channel block (shared rule, ops/blocking.py). Swept 128/256/512 on
+    the v5e: within noise (21.8-22.3 ms at the GoogLeNet shape) — the
+    kernel is VPU-bound, not grid-bound."""
+    return channel_chunk(c)
 
 
 def _batch_chunk(n: int) -> int:
-    """Images per program: 8 amortizes grid/DMA overhead; VMEM per block at
-    (8,32,32,128) is in+out+idx ~= 5 MB of the 16 MB budget."""
-    for nb in (8, 4, 2, 1):
-        if n % nb == 0:
-            return nb
-    return 1
+    """Images per program: pinned to 1 — batch-blocks > 1 trip a Mosaic i1
+    relayout on 4-D masks ("Invalid relayout ... vector<8x32x32x128xi1>");
+    the grid still pipelines DMAs across programs."""
+    return batch_chunk(n, max_nb=1)
 
 
-def _pad_channels(a, cb):
-    c = a.shape[-1]
-    if c % cb == 0:
-        return a, c
-    cpad = -(-c // cb) * cb
-    return jnp.pad(a, [(0, 0)] * 3 + [(0, cpad - c)]), c
+_pad_channels = pad_channels
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "emit_idx"))
@@ -140,7 +157,7 @@ def _max_pool3x3_fwd(x, interpret=False, emit_idx=True):
             out_specs=(out_spec, _spec((nb, h, w, cb))),
             out_shape=(
                 out_shape,
-                jax.ShapeDtypeStruct((n, h, w, cp), jnp.int8),
+                jax.ShapeDtypeStruct((n, h, w, cp), x.dtype),
             ),
             interpret=interpret,
         )(x)

@@ -70,6 +70,12 @@ log = logging.getLogger(__name__)
 class Trainer:
     def __init__(self, config: TrainConfig):
         self.config = config
+        from pytorch_cifar_tpu.models.common import set_dense_grouped_conv
+
+        # unconditional: a later Trainer in the same process must not
+        # inherit an earlier one's flag (process-global trace-time state);
+        # set before any tracing — jit traces lazily at first step call
+        set_dense_grouped_conv(config.dense_grouped_conv)
         if config.distributed:
             initialize_distributed()
         if is_primary():

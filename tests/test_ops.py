@@ -122,3 +122,88 @@ def test_max_pool3x3_gradient_mass_conserved_bf16():
         float(g.astype(jnp.float32).sum()),
         rtol=1e-2,
     )
+
+
+def test_fused_moments_matches_twin_reduce():
+    """ops/bn_stats.py one-pass (E[x], E[x^2]) vs the stock twin-reduce,
+    including the w<8 sublane shape where the TPU compile miscomputes
+    (BENCHMARKS.md) — interpret mode must be exact everywhere."""
+    from pytorch_cifar_tpu.ops.bn_stats import fused_moments
+
+    for shape in [(4, 8, 8, 16), (8, 4, 4, 256), (6, 8, 8, 130)]:
+        x = jax.random.normal(jax.random.PRNGKey(1), shape).astype(
+            jnp.bfloat16
+        )
+        m, sq = fused_moments(x, True)
+        xf = x.astype(jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(m), np.asarray(jnp.mean(xf, axis=(0, 1, 2))),
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sq),
+            np.asarray(jnp.mean(jnp.square(xf), axis=(0, 1, 2))),
+            atol=1e-5,
+        )
+
+
+def test_fused_moments_gradient():
+    """The custom VJP (a + 2bx)/n must match autodiff of the twin-reduce."""
+    from pytorch_cifar_tpu.ops.bn_stats import fused_moments
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 8, 16))
+
+    def loss_fused(v):
+        m, sq = fused_moments(v, True)
+        return jnp.sum(m * 2.0) + jnp.sum(sq * 3.0)
+
+    def loss_ref(v):
+        vf = v.astype(jnp.float32)
+        return (
+            jnp.sum(jnp.mean(vf, axis=(0, 1, 2)) * 2.0)
+            + jnp.sum(jnp.mean(jnp.square(vf), axis=(0, 1, 2)) * 3.0)
+        )
+
+    g1 = jax.grad(loss_fused)(x)
+    g2 = jax.grad(loss_ref)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+
+
+def test_bn_moments_impl_hook_swaps_implementation():
+    """models.common.bn_moments_impl reroutes BatchNorm's moment
+    computation at trace time without changing semantics."""
+    from pytorch_cifar_tpu.models.common import BatchNorm, bn_moments_impl
+    from pytorch_cifar_tpu.ops.bn_stats import fused_moments
+
+    bn = BatchNorm(use_running_average=False)
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 8, 8, 16))
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    y_ref, st_ref = bn.apply(x=x, variables=variables, mutable=["batch_stats"])
+    with bn_moments_impl(lambda v: fused_moments(v, True)):
+        y_new, st_new = bn.apply(
+            x=x, variables=variables, mutable=["batch_stats"]
+        )
+    np.testing.assert_allclose(
+        np.asarray(y_ref), np.asarray(y_new), atol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st_ref), jax.tree_util.tree_leaves(st_new)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_dense_grouped_conv_equivalent():
+    """dense_grouped_conv computes bit-comparable outputs to the native
+    grouped lowering (the expansion's extra terms are exact zeros), and the
+    gate excludes depthwise (channels-per-group 1, measured 14x slower
+    dense — BENCHMARKS.md round 2)."""
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.models.common import dense_grouped_conv
+
+    m = create_model("ResNeXt29_32x4d")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    v = m.init(jax.random.PRNGKey(1), x, train=False)
+    y1 = m.apply(v, x, train=False)
+    with dense_grouped_conv():
+        y2 = m.apply(v, x, train=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)

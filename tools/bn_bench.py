@@ -1,0 +1,118 @@
+"""A/B the fused one-pass batch-moments kernel against XLA's twin-reduce.
+
+Two levels:
+1. op-level at each ResNet18 BN shape (fwd and fwd+vjp, chained + D2H sync);
+2. full-model: ResNet18 b512 train step with BatchNorm's moment computation
+   monkeypatched to the fused kernel, against the stock step.
+
+  python tools/bn_bench.py            # op-level sweep + full-step A/B
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_cifar_tpu.ops.bn_stats import fused_moments
+
+    interpret = jax.devices()[0].platform == "cpu"
+    steps, repeats = (3, 1) if interpret else (30, 3)
+
+    def bench(fn, v, chain=True):
+        r = fn(v)
+        jax.tree_util.tree_map(
+            lambda t: float(jnp.asarray(t).reshape(-1)[0].astype(jnp.float32)), r
+        )
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = v
+            for _ in range(steps):
+                out = fn(out if chain else v)
+            jax.tree_util.tree_map(
+                lambda t: float(
+                    jnp.asarray(t).reshape(-1)[0].astype(jnp.float32)
+                ),
+                out,
+            )
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best * 1e3
+
+    # -- op level: value+grad of a scalar built from the moments, chained
+    # through x so steps serialize ------------------------------------
+    def make(op):
+        def f(x):
+            def loss(v):
+                m, sq = op(v)
+                return jnp.sum(m) + jnp.sum(sq)
+
+            g = jax.grad(loss)(x)
+            return (x + 0.001 * g.astype(x.dtype)).astype(x.dtype)
+
+        return jax.jit(f)
+
+    def xla_moments(v):
+        vf = v.astype(jnp.float32)
+        axes = tuple(range(v.ndim - 1))
+        return jnp.mean(vf, axis=axes), jnp.mean(jnp.square(vf), axis=axes)
+
+    shapes = [
+        (512, 32, 32, 64),
+        (512, 16, 16, 128),
+        (512, 8, 8, 256),
+        (512, 4, 4, 512),
+    ]
+    if interpret:
+        shapes = [(8, 32, 32, 64)]
+    for shape in shapes:
+        x = jnp.asarray(np.random.RandomState(0).randn(*shape), jnp.bfloat16)
+        xla_ms = bench(make(xla_moments), x)
+        pal_ms = bench(
+            make(lambda v: fused_moments(v, interpret)), x
+        )
+        # correctness at the bench shape
+        m1 = xla_moments(x)
+        m2 = fused_moments(x, interpret)
+        err = max(
+            float(jnp.max(jnp.abs(m1[0] - m2[0]))),
+            float(jnp.max(jnp.abs(m1[1] - m2[1]))),
+        )
+        print(
+            f"moments+vjp {str(shape):>20}  XLA={xla_ms:.3f} ms  "
+            f"Pallas={pal_ms:.3f} ms  speedup={xla_ms / pal_ms:.2f}x  "
+            f"max|d|={err:.2e}"
+        )
+
+    # -- full-model A/B: ResNet18 train step with swapped BN moments ----
+    from pytorch_cifar_tpu.models.common import bn_moments_impl
+    from bench import run_one
+
+    stock = run_one("ResNet18", 8 if interpret else 512, steps, 5, jnp.bfloat16,
+                    repeats=repeats)
+    with bn_moments_impl(lambda v: fused_moments(v, interpret)):
+        # trace-time switch: run_one rebuilds + re-traces the step inside
+        fused = run_one("ResNet18", 8 if interpret else 512, steps, 5,
+                        jnp.bfloat16, repeats=repeats)
+    print(
+        f"ResNet18 train step  stock={stock:.0f} img/s  "
+        f"fused-BN-moments={fused:.0f} img/s  ratio={fused / stock:.3f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""A/B the Pallas 3x3/s1 max-pool kernel against XLA's native lowering.
+
+Measures fwd+bwd (the training cost: XLA's backward is select-and-scatter,
+GoogLeNet's biggest single op class — BENCHMARKS.md) at the Inception-cell
+shape by chaining calls through a data dependency and syncing with a D2H
+scalar fetch (block_until_ready returns early through the axon transport).
+
+  python tools/pool_bench.py                 # (512,32,32,480) bf16
+  python tools/pool_bench.py --n 512 --c 128 --dtype float32
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n", type=int, default=512)
+    parser.add_argument("--h", type=int, default=32)
+    parser.add_argument("--c", type=int, default=480)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args()
+    interpret = jax.devices()[0].platform == "cpu"
+    if interpret:  # CPU: Pallas runs in interpret mode; clamp the work
+        args.n, args.steps, args.repeats = min(args.n, 8), 2, 1
+        args.c = min(args.c, 96)
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    shape = (args.n, args.h, args.h, args.c)
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(*shape), dtype
+    )
+
+    def xla_pool(v):
+        import flax.linen as nn
+
+        return nn.max_pool(
+            v, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1))
+        )
+
+    def make_fwd_bwd(pool):
+        # value+grad chained through the input so steps serialize
+        def f(v):
+            out, vjp = jax.vjp(pool, v)
+            (gi,) = vjp(out)  # cotangent = out, keeps one pass
+            return gi
+
+        return jax.jit(f)
+
+    def bench(fn, v):
+        fn_c = fn
+        r = fn_c(v)
+        float(jnp.sum(r[0, 0, 0]))  # compile + sync
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            out = v
+            for _ in range(args.steps):
+                out = fn_c(out)
+            float(jnp.sum(out[0, 0, 0]))  # D2H sync
+            dt = (time.perf_counter() - t0) / args.steps
+            best = min(best, dt)
+        return best * 1e3
+
+    pallas_pool = lambda v: max_pool3x3_s1(v, interpret)
+    xla_ms = bench(make_fwd_bwd(xla_pool), x)
+    pal_ms = bench(make_fwd_bwd(pallas_pool), x)
+    # numeric check at the bench shape (not just the unit-test shapes)
+    g1 = make_fwd_bwd(xla_pool)(x)
+    g2 = make_fwd_bwd(pallas_pool)(x)
+    err = float(jnp.max(jnp.abs(g1.astype(jnp.float32) - g2.astype(jnp.float32))))
+    print(
+        f"shape={shape} dtype={args.dtype}  "
+        f"XLA(select-and-scatter)={xla_ms:.2f} ms  "
+        f"Pallas(winner-index)={pal_ms:.2f} ms  "
+        f"speedup={xla_ms / pal_ms:.2f}x  max|dgrad|={err:.3g}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
