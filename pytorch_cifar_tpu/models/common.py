@@ -148,6 +148,37 @@ class _TorchGroupedConv(nn.Conv):
 
     @nn.compact
     def __call__(self, x):
+        # This override implements only the slice of nn.Conv's surface the
+        # zoo uses; anything else must fail LOUDLY here rather than be
+        # silently ignored (e.g. a dilation computing an undilated conv).
+        if not (
+            isinstance(self.padding, (list, tuple))
+            and all(
+                isinstance(p, (list, tuple)) and len(p) == 2
+                for p in self.padding
+            )
+        ):
+            raise NotImplementedError(
+                "_TorchGroupedConv requires explicit [(low, high), ...] "
+                f"padding, got {self.padding!r} (string modes like 'SAME' "
+                "are not handled by this override)"
+            )
+        def unit(d):
+            if d is None or d == 1:
+                return True
+            try:
+                return all(int(v) == 1 for v in d)
+            except TypeError:
+                return False
+
+        if (
+            not unit(self.kernel_dilation)
+            or not unit(self.input_dilation)
+            or self.mask is not None
+        ):
+            raise NotImplementedError(
+                "_TorchGroupedConv does not implement dilation or masking"
+            )
         g = self.feature_group_count
         cin = x.shape[-1]
         cpg = cin // g

@@ -591,6 +591,15 @@ class Trainer:
         snap = self._snapshot
         if snap is None or snap[1] == self._written_epoch:
             return
+        if (
+            self._written_epoch is not None
+            and self.config.checkpoint_every > 0
+            and snap[1] - self._written_epoch < self.config.checkpoint_every
+        ):
+            # too soon: keep the device snapshot current but skip the disk
+            # write (each one stalls training ~14 s on a serialized host
+            # link); flush_checkpoints writes the final best regardless
+            return
 
         def work():
             # _written_epoch is only advanced on SUCCESS: a failed write

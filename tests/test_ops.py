@@ -207,3 +207,42 @@ def test_dense_grouped_conv_equivalent():
     with dense_grouped_conv():
         y2 = m.apply(v, x, train=False)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)
+
+
+def _conv_group_counts(fn, *args):
+    """feature_group_count of every conv eqn in fn's jaxpr."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    counts = []
+
+    def walk(jp):
+        for eqn in jp.eqns:
+            if eqn.primitive.name == "conv_general_dilated":
+                counts.append(eqn.params["feature_group_count"])
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return counts
+
+
+def test_dense_grouped_conv_gate():
+    """The switch must EXPAND narrow groups (1 < cpg <= 16 -> group count
+    1 in the traced conv) but leave depthwise (cpg == 1) grouped — dense
+    depthwise measured 14x slower (BENCHMARKS.md); equivalence tests
+    cannot catch a gate regression because outputs match at any cpg."""
+    from pytorch_cifar_tpu.models.common import Conv, dense_grouped_conv
+
+    x = jnp.zeros((2, 8, 8, 32))
+
+    def run(groups):
+        conv = Conv(32, 3, padding=1, groups=groups, use_bias=False)
+        v = conv.init(jax.random.PRNGKey(0), x)
+        return lambda inp: conv.apply(v, inp)
+
+    with dense_grouped_conv():
+        assert _conv_group_counts(run(8), x) == [1]  # cpg=4: expanded
+        assert _conv_group_counts(run(32), x) == [32]  # depthwise: native
+        assert _conv_group_counts(run(2), x) == [1]  # cpg=16: boundary, expanded
+    # without the switch nothing expands
+    assert _conv_group_counts(run(8), x) == [8]
