@@ -99,3 +99,26 @@ def test_bench_eval_mode_prints_one_json_line():
     rec = json.loads(json_lines[0])
     assert rec["metric"].startswith("eval_throughput_LeNet"), rec["metric"]
     assert rec["value"] > 0
+
+
+def test_bench_epoch_mode_prints_one_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
+         "--epoch", "--batch", "128", "--repeats", "1"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env=env,
+        check=True,
+    )
+    json_lines = [
+        l for l in out.stdout.splitlines() if l.strip().startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    rec = json.loads(json_lines[0])
+    assert rec["metric"].startswith("epoch_throughput_LeNet_b128")
+    assert rec["metric"].endswith("_cpu")
+    assert rec["value"] > 0
