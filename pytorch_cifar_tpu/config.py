@@ -87,6 +87,11 @@ class TrainConfig:
     # cross-shard BN reductions. 1 = pure data parallel (reference scope).
     # The vision analogue of sequence/context parallelism.
     spatial_devices: int = 1
+    # additionally shard image WIDTH over a third mesh axis — context
+    # parallelism over both image axes (2-D halo exchanges). Requires the
+    # device-resident data plane (the host loader assembles batch x height
+    # slabs only).
+    spatial_w_devices: int = 1
 
     # checkpointing (reference: main.py:136-148)
     output_dir: str = "./checkpoint"

@@ -21,7 +21,9 @@ from pytorch_cifar_tpu.parallel.dp import (
 )
 from pytorch_cifar_tpu.parallel.spatial import (
     SPATIAL_AXIS,
+    SPATIAL_W_AXIS,
     make_2d_mesh,
+    make_spatial_mesh,
     put_spatial,
     spatial_batch_sharding,
     spatial_eval_epoch,

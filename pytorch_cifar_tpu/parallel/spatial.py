@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pytorch_cifar_tpu.parallel.mesh import DATA_AXIS
 
 SPATIAL_AXIS = "spatial"
+SPATIAL_W_AXIS = "spatial_w"
 
 
 def make_2d_mesh(
@@ -43,21 +44,50 @@ def make_2d_mesh(
     devices=None,
 ) -> Mesh:
     """(data x spatial) mesh. data=0 means "all devices / spatial"."""
+    return make_spatial_mesh(data=data, spatial=spatial, devices=devices)
+
+
+def make_spatial_mesh(
+    data: int = 0,
+    spatial: int = 1,
+    spatial_w: int = 1,
+    devices=None,
+) -> Mesh:
+    """(data x spatial[_h] [x spatial_w]) mesh.
+
+    ``spatial_w > 1`` additionally shards the image WIDTH — context
+    parallelism over both image axes (halo exchanges in both directions,
+    all derived by GSPMD). The mesh stays 2-D when spatial_w == 1 so
+    existing (data x spatial) call sites and shape assertions are
+    unchanged. data=0 means "all devices / (spatial*spatial_w)".
+    """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if spatial < 1 or n % spatial:
-        raise ValueError(f"spatial={spatial} must divide device count {n}")
+    sp = spatial * spatial_w
+    if spatial < 1 or spatial_w < 1 or n % sp:
+        raise ValueError(
+            f"spatial={spatial} x spatial_w={spatial_w} must divide "
+            f"device count {n}"
+        )
     if not data:
-        data = n // spatial
-    if data * spatial > n:
-        raise ValueError(f"{data}x{spatial} mesh exceeds {n} devices")
-    grid = np.asarray(devices[: data * spatial]).reshape(data, spatial)
-    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+        data = n // sp
+    if data * sp > n:
+        raise ValueError(
+            f"{data}x{spatial}x{spatial_w} mesh exceeds {n} devices"
+        )
+    if spatial_w == 1:
+        grid = np.asarray(devices[: data * sp]).reshape(data, spatial)
+        return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+    grid = np.asarray(devices[: data * sp]).reshape(data, spatial, spatial_w)
+    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS, SPATIAL_W_AXIS))
 
 
 def spatial_batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Images (N,H,W,C): batch over ``data``, height over ``spatial``."""
+    """Images (N,H,W,C): batch over ``data``, height over ``spatial``,
+    and width over ``spatial_w`` when the mesh has that axis."""
+    if SPATIAL_W_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS, SPATIAL_W_AXIS))
     return NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS))
 
 

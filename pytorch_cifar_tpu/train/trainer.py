@@ -35,7 +35,7 @@ from pytorch_cifar_tpu.parallel import (
     data_parallel_train_epoch,
     data_parallel_train_step,
     initialize_distributed,
-    make_2d_mesh,
+    make_spatial_mesh,
     make_mesh,
     replicate,
     spatial_batch_sharding,
@@ -99,26 +99,48 @@ class Trainer:
         self.train_images, self.train_labels = tr_x, tr_y
         self.test_images, self.test_labels = te_x, te_y
 
+        # single source of truth for where augmentation runs: host pipeline
+        # (native data plane) vs on-device prologue of the train step —
+        # derived BEFORE the mesh section because the spatial_w guard needs
+        # the effective data-plane decision, not the raw flags
+        host_aug = config.host_augment and config.random_crop
+        device_data = config.device_data and not host_aug
+
         # -- mesh ------------------------------------------------------
         self.spatial = max(config.spatial_devices, 1)
-        if self.spatial > 1:
+        self.spatial_w = max(config.spatial_w_devices, 1)
+        if self.spatial > 1 or self.spatial_w > 1:
             # multi-process works too: the loader derives this process's
             # (batch x height) slab from the sharding itself (pipeline.py
             # local_slab) and assembles global arrays from local slabs
+            sp_total = self.spatial * self.spatial_w
             total = config.num_devices or len(jax.devices())
-            if total % self.spatial:
+            if total % sp_total:
                 raise ValueError(
-                    f"spatial_devices={self.spatial} must divide the "
+                    f"spatial_devices={self.spatial} x "
+                    f"spatial_w_devices={self.spatial_w} must divide the "
                     f"device count {total}"
                 )
-            if 32 % self.spatial:
-                # height shards must be even or GSPMD silently pads/degrades
+            for name, v in (
+                ("spatial_devices", self.spatial),
+                ("spatial_w_devices", self.spatial_w),
+            ):
+                if 32 % v:
+                    # uneven shards: GSPMD silently pads/degrades
+                    raise ValueError(
+                        f"{name}={v} must divide the 32-pixel CIFAR "
+                        "image extent"
+                    )
+            if self.spatial_w > 1 and not device_data:
                 raise ValueError(
-                    f"spatial_devices={self.spatial} must divide the "
-                    "32-pixel CIFAR image height"
+                    "spatial_w_devices > 1 requires the device-resident "
+                    "data plane (--device_data, no --host_augment): the "
+                    "host loader assembles batch x height slabs only"
                 )
-            self.mesh = make_2d_mesh(
-                data=total // self.spatial, spatial=self.spatial
+            self.mesh = make_spatial_mesh(
+                data=total // sp_total,
+                spatial=self.spatial,
+                spatial_w=self.spatial_w,
             )
             n_dev = self.mesh.shape[DATA_AXIS]  # batch divides the data axis
         else:
@@ -144,16 +166,12 @@ class Trainer:
         self.global_batch = max(config.batch_size // n_dev, 1) * n_dev
         eval_bs = max(config.eval_batch_size // n_dev, 1) * n_dev
 
-        if self.spatial > 1:
+        if self.spatial > 1 or self.spatial_w > 1:
             sharding = spatial_batch_sharding(self.mesh)
             lbl_sharding = spatial_label_sharding(self.mesh)
         else:
             sharding = batch_sharding(self.mesh)
             lbl_sharding = sharding
-        # single source of truth for where augmentation runs: host pipeline
-        # (native data plane) vs on-device prologue of the train step
-        host_aug = config.host_augment and config.random_crop
-        device_data = config.device_data and not host_aug
         if config.evaluate:
             # eval-only: no shuffling/augmenting loader or train step needed;
             # steps_per_epoch (which anchors the LR schedule restored from
@@ -275,7 +293,7 @@ class Trainer:
         eval_kwargs = dict(
             mean=config.mean, std=config.std, compute_dtype=compute
         )
-        if self.spatial > 1:
+        if self.spatial > 1 or self.spatial_w > 1:
             # GSPMD path: GLOBAL-semantics step (no axis_name — the
             # compiler derives halo exchanges, BN reductions, grad
             # all-reduce from the sharding annotations). BN statistics are
