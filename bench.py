@@ -17,10 +17,13 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.json:
-"published": {}), so the baseline is the newest prior-round capture of the
-SAME metric in the driver's BENCH_r{N}.json history — a regression shows up
-as vs_baseline < 1. Falls back to 1.0 when no prior capture matches (round
-1, or a metric/platform not benched before).
+"published": {}), so the baseline is the OLDEST capture of the SAME metric
+in the driver's BENCH_r{N}.json history — the first round that measured a
+metric is its permanent baseline, and vs_baseline is cumulative progress
+since then, NOT a round-over-round regression check (see
+``prior_round_value`` for why newest-round would self-compare). Falls back
+to 1.0 when no prior capture matches (round 1, or a metric/platform not
+benched before).
 """
 
 from __future__ import annotations

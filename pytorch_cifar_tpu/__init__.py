@@ -51,8 +51,24 @@ def honor_platform_env() -> None:
         jax.config.update("jax_platforms", "cpu")
         # concurrent multi-partition executions additionally contend for
         # the same worker threads; serializing CPU dispatch keeps one
-        # execution's partitions from starving another's rendezvous
-        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        # execution's partitions from starving another's rendezvous.
+        # Scoped to MULTI-device CPU (virtual-device meshes): a
+        # single-device CPU run has no rendezvous to protect and keeps
+        # async dispatch pipelining.
+        import re as _re
+
+        m = _re.search(
+            r"--xla_force_host_platform_device_count=(\d+)",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        n = int(m.group(1)) if m else 1
+        # virtual CPU devices can also be provisioned via JAX_NUM_CPU_DEVICES
+        try:
+            n = max(n, int(os.environ.get("JAX_NUM_CPU_DEVICES", "1")))
+        except ValueError:
+            pass
+        if n > 1:
+            jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 
 def tpu_compiler_options(device=None):

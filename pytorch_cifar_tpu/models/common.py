@@ -216,6 +216,7 @@ class _TorchGroupedConv(nn.Conv):
             padding=list(self.padding),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=g,
+            precision=self.precision,
         )
         if bias is not None:
             out = out + bias

@@ -617,6 +617,14 @@ class Trainer:
             # too soon: keep the device snapshot current but skip the disk
             # write (each one stalls training ~14 s on a serialized host
             # link); flush_checkpoints writes the final best regardless
+            log.info(
+                "checkpoint write throttled (epoch %d; last on-disk best is "
+                "epoch %d, next write at epoch >= %d) — a crash before then "
+                "resumes from the on-disk state",
+                snap[1],
+                self._written_epoch,
+                self._written_epoch + self.config.checkpoint_every,
+            )
             return
 
         def work():
