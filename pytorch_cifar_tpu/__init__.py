@@ -57,11 +57,12 @@ def honor_platform_env() -> None:
         # async dispatch pipelining.
         import re as _re
 
-        m = _re.search(
+        # XLA honors the LAST occurrence of a repeated flag
+        counts = _re.findall(
             r"--xla_force_host_platform_device_count=(\d+)",
             os.environ.get("XLA_FLAGS", ""),
         )
-        n = int(m.group(1)) if m else 1
+        n = int(counts[-1]) if counts else 1
         # virtual CPU devices can also be provisioned via JAX_NUM_CPU_DEVICES
         try:
             n = max(n, int(os.environ.get("JAX_NUM_CPU_DEVICES", "1")))

@@ -6,6 +6,8 @@ and cross-shard BN reductions are semantically invisible. That makes these
 tests exact equivalence checks, not smoke tests.
 """
 
+import re
+
 import numpy as np
 import pytest
 
@@ -214,3 +216,108 @@ def test_spatial_w_requires_device_data(tmp_path):
     )
     with pytest.raises(ValueError, match="device-resident"):
         Trainer(cfg)
+
+
+# ---------------------------------------------------------------------------
+# HLO-level proof that GSPMD really partitions spatially.
+#
+# The equivalence tests above would also pass if the partitioner silently
+# all-gathered the full image before every conv (correct, but not spatial —
+# and on real multi-chip hardware a bandwidth cliff, not a correctness bug).
+# These tests lower the spatial train step and inspect the compiled HLO for
+# the halo-exchange signature: many conv-attributed collective-permutes
+# whose payload is a single boundary row/column, and (almost) no
+# all-gathers. Measured on this mesh (round 3): 96 permutes / 1 all-gather
+# (2-D), 188 permutes / 0 all-gathers (3-D); the one legitimate gather is
+# the 4x4x512 tail feature map regathered at the global average pool, where
+# each of 4 H-shards holds a single row and halo exchange no longer makes
+# sense.
+# ---------------------------------------------------------------------------
+
+_SHAPE_RE = re.compile(r"=\s+\(?(\w+)\[([\d,]*)\]")
+_BYTEWIDTH = {
+    "f64": 8, "s64": 8, "u64": 8, "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2, "u8": 1, "s8": 1, "pred": 1,
+}
+
+
+def _op_lines(hlo_text, op):
+    """HLO instruction lines whose op is ``op`` (sync, or async ``-start``
+    only — counting the paired ``-done`` line too would double-count one
+    logical collective)."""
+    return [
+        line.strip()
+        for line in hlo_text.splitlines()
+        if f" {op}(" in line or f" {op}-start(" in line
+    ]
+
+
+def _result_dims(line):
+    m = _SHAPE_RE.search(line)
+    if not m:
+        return None, None
+    dtype, dims = m.groups()
+    shape = tuple(int(d) for d in dims.split(",") if d)
+    return dtype, shape
+
+
+def _result_bytes(line):
+    dtype, shape = _result_dims(line)
+    if shape is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= d
+    return n * _BYTEWIDTH.get(dtype, 4)
+
+
+@pytest.mark.parametrize("mesh_axes", [(4, 1), (2, 2)], ids=["2d_H", "3d_HW"])
+def test_spatial_step_hlo_uses_halo_exchange_not_allgather(mesh_axes):
+    from pytorch_cifar_tpu.parallel.spatial import make_spatial_mesh
+
+    spatial, spatial_w = mesh_axes
+    mesh = make_spatial_mesh(spatial=spatial, spatial_w=spatial_w)
+    state = make_state(seed=0)
+    step = spatial_train_step(make_train_step(augment=False), mesh)
+    x = jnp.zeros((16, 32, 32, 3), jnp.uint8)
+    y = jnp.zeros((16,), jnp.int32)
+    hlo = step.lower(state, (x, y), jax.random.PRNGKey(0)).compile().as_text()
+
+    permutes = _op_lines(hlo, "collective-permute")
+    gathers = _op_lines(hlo, "all-gather")
+    reduces = _op_lines(hlo, "all-reduce")
+
+    # Halo exchange exists and dominates: ResNet18 has 20 3x3 convs, each
+    # needing boundary exchange in forward AND transpose — expect dozens of
+    # permutes (96 and 188 measured), not a handful.
+    assert len(permutes) >= 20, f"only {len(permutes)} collective-permutes"
+
+    # The permutes are halos: a single boundary row/column of the per-shard
+    # activation (some spatial dim == 1), never a whole-activation payload.
+    halo_shaped = [
+        line for line in permutes if 1 in (_result_dims(line)[1] or ())
+    ]
+    assert len(halo_shaped) >= 20, "no single-row/column halo payloads found"
+    assert max(_result_bytes(line) for line in permutes) < 512 * 1024
+
+    # No pessimistic full-activation all-gathers. The only gather permitted
+    # is the tail regather at the global pool: a feature map whose per-shard
+    # H (or W) extent has shrunk to one row, spatial extent <= 4, < 512 KB.
+    assert len(gathers) <= 1, f"{len(gathers)} all-gathers:\n" + "\n".join(
+        g[:200] for g in gathers
+    )
+    for g in gathers:
+        _, shape = _result_dims(g)
+        assert shape is not None and len(shape) == 4
+        assert shape[1] <= 4 and shape[2] <= 4, f"large all-gather: {g[:200]}"
+        assert _result_bytes(g) < 512 * 1024
+
+    # Cross-shard reductions (BN batch stats + gradient sync) are
+    # per-channel all-reduces, present in force.
+    assert len(reduces) >= 10
+
+    # Attribution: halo permutes hang off conv ops (fwd or transpose).
+    conv_attributed = [
+        line for line in permutes if "conv_general_dilated" in line
+    ]
+    assert conv_attributed, "no collective-permute attributed to a conv"
