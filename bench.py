@@ -184,8 +184,16 @@ def run_one(
 def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
     """Production-path throughput: whole epochs through the Trainer —
     device-resident dataset, one-dispatch epoch scan, everything the real
-    run does except checkpoint writes. images/sec over a full warm epoch
-    (50k synthetic images at the real CIFAR shapes on accelerators)."""
+    run does except checkpoint writes. images/sec over warm epochs
+    (50k synthetic images at the real CIFAR shapes on accelerators).
+
+    Measurement window: WINDOW epochs dispatched back-to-back with ONE
+    metric fetch at the end — exactly the schedule the pipelined fit()
+    runs (trainer.py). Timing single epochs each ending in a fetch would
+    charge the ~100 ms host round-trip of the remote-TPU transport to
+    every epoch; fit() pays it once per run of dispatches (measured round
+    3: 1-epoch windows 34.1k img/s, 8-epoch windows 37.2k — the
+    difference IS the round-trip, not device time)."""
     import tempfile
 
     from pytorch_cifar_tpu.config import TrainConfig
@@ -193,6 +201,7 @@ def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
 
     on_cpu = jax.devices()[0].platform == "cpu"
     n_train = 2048 if on_cpu else 50_000
+    window = 1 if on_cpu else 4  # CPU runs are smoke, not measurements
     with tempfile.TemporaryDirectory(prefix="bench_epoch_") as out_dir:
         cfg = TrainConfig(
             model=model,
@@ -207,7 +216,7 @@ def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
             amp=compute_dtype == jnp.bfloat16,
             output_dir=out_dir,
             log_every=10**9,
-            epochs=max(repeats, 1) + 1,
+            epochs=max(repeats, 1) * window + 1,
             # ONE device: the metric is per-chip; the Trainer's default
             # mesh spans every local chip and would report mesh throughput
             num_devices=1,
@@ -215,12 +224,18 @@ def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
         trainer = Trainer(cfg)
         trainer.train_epoch(0)  # compiles + one-time dataset staging
         best = 0.0
-        for i in range(1, max(repeats, 1) + 1):
+        epoch = 1
+        for _ in range(max(repeats, 1)):
             t0 = time.perf_counter()
-            loss, _ = trainer.train_epoch(i)
+            totals = None
+            for _ in range(window):
+                totals = trainer._dispatch_train_epoch(epoch)
+                epoch += 1
+            m = jax.device_get(totals)  # one sync per window, like fit()
             dt = time.perf_counter() - t0
+            loss = float(m["loss_sum"]) / max(float(m["count"]), 1)
             assert np.isfinite(loss), f"non-finite epoch loss for {model}"
-            best = max(best, n_train / dt)
+            best = max(best, window * n_train / dt)
     return best
 
 

@@ -170,6 +170,7 @@ def make_train_epoch(
     n_shards: int = 1,
     batch_sharding=None,
     label_sharding=None,
+    dma_gather: bool = False,
 ) -> Callable:
     """Compile a WHOLE training epoch into one XLA computation.
 
@@ -192,21 +193,69 @@ def make_train_epoch(
 
     Wrap-padded tail rows (extended-permutation positions >= n_data) get
     label -1, masked from loss/grads/metrics exactly like the host path.
+
+    Batch materialization (round 3): on the shard_map/single-device paths
+    the whole epoch's batches are gathered ONCE before the scan — one large
+    row-gather at full HBM bandwidth — and the scan body takes contiguous
+    ``dynamic_slice``s of the pre-gathered block. The previous per-step
+    512-row gather was the dominant cost of the 10% epoch-vs-step
+    throughput gap (BENCHMARKS.md round 3). The GSPMD spatial path keeps
+    the per-step gather: its batches carry a sharding constraint, and a
+    dynamic-slice along a GSPMD-sharded batch dimension would force the
+    partitioner to all-gather (exactly the pessimization
+    tests/test_spatial.py guards against); the bytes are identical either
+    way, only the grouping differs, so results are bit-exact.
     """
     shard_batch = global_batch // max(n_shards, 1)
 
     def epoch_fn(state, totals, images, labels, perm, rng):
+        pregather = batch_sharding is None
+        if pregather:
+            # epoch positions this shard will visit, in visit order:
+            # step i covers [i*global_batch + shard*shard_batch, +shard_batch)
+            pos = (
+                jnp.arange(num_steps, dtype=jnp.int32)[:, None] * global_batch
+                + jnp.arange(shard_batch, dtype=jnp.int32)[None, :]
+            )
+            if axis_name is not None:
+                pos = pos + jax.lax.axis_index(axis_name) * shard_batch
+            pos = pos.reshape(-1)
+            idx = jnp.take(perm, pos, axis=0)
+            if dma_gather:
+                # TPU meshes only (Trainer auto-gates): XLA's row gather
+                # runs descriptor-bound (~5.3 ms for 50k CIFAR rows);
+                # the pipelined-DMA kernel does the same move in ~2.8 ms
+                # incl. layout reshapes (ops/dma_gather.py, BENCHMARKS.md
+                # round 3)
+                from pytorch_cifar_tpu.ops.dma_gather import dma_row_gather
+
+                x_all = dma_row_gather(images, idx)
+            else:
+                x_all = jnp.take(images, idx, axis=0)
+            y_all = jnp.where(
+                pos < n_data, jnp.take(labels, idx, axis=0), -1
+            )
+
         def body(carry, i):
             state, totals = carry
-            start = i * global_batch
-            if axis_name is not None:
-                start = start + jax.lax.axis_index(axis_name) * shard_batch
-            idx = jax.lax.dynamic_slice(perm, (start,), (shard_batch,))
-            x = jnp.take(images, idx, axis=0)
-            y = jnp.take(labels, idx, axis=0)
-            pos = start + jnp.arange(shard_batch, dtype=jnp.int32)
-            y = jnp.where(pos < n_data, y, -1)
-            if batch_sharding is not None:
+            if pregather:
+                x = jax.lax.dynamic_slice_in_dim(
+                    x_all, i * shard_batch, shard_batch, axis=0
+                )
+                y = jax.lax.dynamic_slice_in_dim(
+                    y_all, i * shard_batch, shard_batch, axis=0
+                )
+            else:
+                start = i * global_batch
+                if axis_name is not None:
+                    start = (
+                        start + jax.lax.axis_index(axis_name) * shard_batch
+                    )
+                idx = jax.lax.dynamic_slice(perm, (start,), (shard_batch,))
+                x = jnp.take(images, idx, axis=0)
+                y = jnp.take(labels, idx, axis=0)
+                pos = start + jnp.arange(shard_batch, dtype=jnp.int32)
+                y = jnp.where(pos < n_data, y, -1)
                 # GSPMD path: pin the materialized batch's layout so the
                 # compiler partitions the gather output over the mesh
                 # instead of replicating downstream compute

@@ -45,6 +45,7 @@ from pytorch_cifar_tpu.parallel import (
     spatial_train_epoch,
     spatial_train_step,
 )
+from pytorch_cifar_tpu.ops.dma_gather import rows_dma_tileable
 from pytorch_cifar_tpu.parallel.mesh import is_primary
 from pytorch_cifar_tpu.train.checkpoint import (
     CKPT_NAME,
@@ -302,6 +303,8 @@ class Trainer:
             wrap_eval = lambda fn: spatial_eval_step(fn, self.mesh)
             wrap_train_epoch = lambda fn: spatial_train_epoch(fn, self.mesh)
             wrap_eval_epoch = lambda fn: spatial_eval_epoch(fn, self.mesh)
+            # NOTE: the spatial path keeps its per-step in-scan gather
+            # (see make_train_epoch), which the DMA kernel does not serve
             epoch_kwargs = dict(
                 batch_sharding=sharding, label_sharding=lbl_sharding
             )
@@ -338,6 +341,16 @@ class Trainer:
                         global_batch=self.global_batch,
                         n_data=tr_x.shape[0],
                         num_steps=self.steps_per_epoch,
+                        # Pallas compiles for TPU only; CPU meshes (tests,
+                        # virtual multi-device CI) and row shapes outside
+                        # the kernel's tiling keep the XLA gather. Only
+                        # meaningful on the pre-gather (non-spatial) path
+                        # — make_train_epoch ignores it otherwise.
+                        dma_gather=(
+                            config.dma_gather
+                            and self.mesh.devices.flat[0].platform == "tpu"
+                            and rows_dma_tileable(tr_x.shape[1:])
+                        ),
                         **epoch_kwargs,
                     )
                 )
@@ -474,25 +487,22 @@ class Trainer:
         )
         return loss_sum / max(count, 1), 100.0 * correct / max(count, 1)
 
-    def _train_epoch_compiled(self, epoch: int) -> Tuple[float, float]:
-        """One-dispatch epoch over the device-resident dataset.
+    def _dispatch_train_epoch(self, epoch: int):
+        """Enqueue one whole-epoch computation; return the totals future.
 
-        Host involvement per epoch: one ~200 KB permutation upload, one
-        dispatch, one 12-byte metric fetch. No per-step progress is
-        observable (the whole epoch is a single XLA computation — ~1.4 s
-        for the flagship), so the bar renders once per epoch.
-        """
+        Host involvement: one ~200 KB permutation upload and one dispatch.
+        Nothing here blocks on the device — ``self.state`` advances to the
+        (async) output arrays, and the caller chooses when to sync (the
+        pipelined ``fit`` loop fetches an epoch's totals only after the
+        NEXT epoch is already dispatched, hiding the host round-trip —
+        measured ~100 ms through the remote-TPU transport, ~7%/epoch —
+        behind device compute)."""
         if self.train_epoch_fn is None:
             raise RuntimeError(
                 "Trainer was built with evaluate=True; training is disabled"
             )
-        log.info("\nEpoch: %d", epoch)
-        nb = self.steps_per_epoch
         rng = jax.random.fold_in(self.rng, epoch)
         perm = self.loader.staged_perm(epoch)
-        t0 = time.time()
-        if self._trace_dir:
-            jax.profiler.start_trace(self._trace_dir)
         self.state, totals = self.train_epoch_fn(
             self.state,
             zero_metrics(),
@@ -501,10 +511,10 @@ class Trainer:
             perm,
             rng,
         )
-        m = jax.device_get(totals)  # the one sync of the epoch
-        if self._trace_dir:
-            jax.profiler.stop_trace()
-        dt = time.time() - t0
+        return totals
+
+    def _log_train_totals(self, epoch, m, dt) -> Tuple[float, float]:
+        nb = self.steps_per_epoch
         loss_sum = float(m["loss_sum"])
         correct = float(m["correct"])
         count = float(m["count"])
@@ -530,6 +540,21 @@ class Trainer:
         )
         return loss_sum / max(count, 1), 100.0 * correct / max(count, 1)
 
+    def _train_epoch_compiled(self, epoch: int) -> Tuple[float, float]:
+        """Synchronous one-dispatch epoch (bench/tests and the profiled
+        epoch): dispatch, one 12-byte metric fetch, log. The bar renders
+        once per epoch — the whole epoch is a single XLA computation
+        (~1.4 s for the flagship)."""
+        log.info("\nEpoch: %d", epoch)
+        t0 = time.time()
+        if self._trace_dir:
+            jax.profiler.start_trace(self._trace_dir)
+        totals = self._dispatch_train_epoch(epoch)
+        m = jax.device_get(totals)  # the one sync of the epoch
+        if self._trace_dir:
+            jax.profiler.stop_trace()
+        return self._log_train_totals(epoch, m, time.time() - t0)
+
     def eval_epoch(self, epoch: int) -> Tuple[float, float]:
         # Accumulate the psum'd per-batch metrics ON DEVICE and fetch once:
         # a per-batch device_get would cost one blocking D2H round-trip per
@@ -540,13 +565,7 @@ class Trainer:
         if self.eval_epoch_fn is not None:
             # device-resident test set, whole eval in one dispatch: zero
             # H2D per epoch, one D2H metric fetch
-            m = jax.device_get(
-                self.eval_epoch_fn(
-                    self.state,
-                    self.eval_loader.images,
-                    self.eval_loader.labels,
-                )
-            )
+            m = jax.device_get(self._dispatch_eval_epoch())
         else:
             totals = None
             for x, y in eval_batches(
@@ -560,6 +579,9 @@ class Trainer:
                     else jax.tree_util.tree_map(jnp.add, totals, mm)
                 )
             m = jax.device_get(totals)
+        return self._log_eval_totals(epoch, m)
+
+    def _log_eval_totals(self, epoch, m) -> Tuple[float, float]:
         loss_sum = float(m["loss_sum"])
         correct = float(m["correct"])
         count = float(m["count"])
@@ -572,7 +594,18 @@ class Trainer:
         )
         return loss_sum / max(count, 1), acc
 
-    def maybe_checkpoint(self, epoch: int, acc: float) -> bool:
+    def _dispatch_eval_epoch(self):
+        """Enqueue the compiled eval epoch on the CURRENT state; return
+        the totals future (fetch = sync)."""
+        return self.eval_epoch_fn(
+            self.state,
+            self.eval_loader.images,
+            self.eval_loader.labels,
+        )
+
+    def maybe_checkpoint(
+        self, epoch: int, acc: float, snap_state=None
+    ) -> bool:
         """Best-accuracy checkpoint gate (reference semantics,
         main.py:136-148) — but the disk write is decoupled from the
         training loop: the best state is snapshotted on DEVICE (a
@@ -581,17 +614,28 @@ class Trainer:
         alternative — ~100 MB of device_get at ~7.5 MB/s — costs ~14 s,
         ten times the epoch it interrupts (measured; BENCHMARKS.md).
         ``flush_checkpoints`` (called by fit) guarantees the newest
-        snapshot is on disk before the run ends."""
+        snapshot is on disk before the run ends.
+
+        ``snap_state``: a device-side copy of the state that achieved
+        ``acc``, taken by the caller. The pipelined fit loop must pass it:
+        by the time an epoch's eval metrics are fetched, the next epoch's
+        dispatch has already donated ``self.state``'s buffers, so the
+        snapshot has to be taken at dispatch time."""
         if acc > self.best_acc:
             self.best_acc = acc
             log.info("Saving.. (best acc %.2f%%)", acc)
             if not self.config.async_checkpoint:
                 save_checkpoint(
-                    self.config.output_dir, self.state, epoch, self.best_acc
+                    self.config.output_dir,
+                    self.state if snap_state is None else snap_state,
+                    epoch,
+                    self.best_acc,
                 )
                 return True
             self._snapshot = (
-                self._copy_state(self.state),
+                self._copy_state(self.state)
+                if snap_state is None
+                else snap_state,
                 epoch,
                 self.best_acc,
             )
@@ -689,15 +733,64 @@ class Trainer:
             )
         except ValueError:
             pass
+        # Pipelined epoch schedule (compiled data plane only): epoch e's
+        # metrics are fetched AFTER epoch e+1 (train + eval) is already
+        # enqueued, so the two host round-trips per epoch (~100 ms each
+        # through the remote-TPU transport — measured, BENCHMARKS.md round
+        # 3) overlap device compute instead of stalling it. The device
+        # executes in dispatch order, so train(e+1)'s donation of the
+        # state buffers cannot clobber eval(e)'s reads. ``pending`` holds
+        # one epoch's (epoch, train totals, eval totals, state snapshot,
+        # start time); the snapshot is taken at dispatch time because the
+        # buffers are donated away before the metrics arrive.
+        pipelined = (
+            self.train_epoch_fn is not None and self.eval_epoch_fn is not None
+        )
+        pending = None
+        # finish-to-finish interval: in steady state one finish per epoch,
+        # so this is the true wall time an epoch occupies (dispatch-to-
+        # fetch would fold the previous epoch's drain into the window and
+        # under-report img/s)
+        last_mark = time.time()
+
+        def finish(p):
+            nonlocal last_mark
+            epoch_, tr_totals, ev_totals, snap = p
+            m = jax.device_get(tr_totals)
+            now = time.time()
+            self._log_train_totals(epoch_, m, now - last_mark)
+            last_mark = now
+            _, acc = self._log_eval_totals(epoch_, jax.device_get(ev_totals))
+            self.maybe_checkpoint(epoch_, acc, snap_state=snap)
+
         try:
             for epoch in range(self.start_epoch, cfg.epochs):
-                if cfg.profile and epoch == profile_epoch and is_primary():
-                    self._trace_dir = f"{cfg.output_dir}/profile"
-                self.train_epoch(epoch)
-                self._trace_dir = None
-                _, acc = self.eval_epoch(epoch)
-                self.maybe_checkpoint(epoch, acc)
+                profiled = (
+                    cfg.profile and epoch == profile_epoch and is_primary()
+                )
+                if pipelined and not profiled:
+                    log.info("\nEpoch: %d", epoch)
+                    tr_totals = self._dispatch_train_epoch(epoch)
+                    ev_totals = self._dispatch_eval_epoch()
+                    snap = self._copy_state(self.state)
+                    if pending is not None:
+                        finish(pending)
+                    pending = (epoch, tr_totals, ev_totals, snap)
+                else:
+                    if pending is not None:
+                        finish(pending)
+                        pending = None
+                    if profiled:
+                        self._trace_dir = f"{cfg.output_dir}/profile"
+                    self.train_epoch(epoch)
+                    self._trace_dir = None
+                    _, acc = self.eval_epoch(epoch)
+                    self.maybe_checkpoint(epoch, acc)
+                    last_mark = time.time()  # sync epoch timed itself
                 if self._agreed_stop():
+                    if pending is not None:
+                        finish(pending)
+                        pending = None
                     log.info(
                         "stop requested: saving preemption checkpoint at "
                         "epoch %d",
@@ -712,6 +805,9 @@ class Trainer:
                     )
                     break
             else:
+                if pending is not None:
+                    finish(pending)
+                    pending = None
                 # completed normally: a leftover preemption save is now
                 # stale; remove it so a routine relaunch with --resume
                 # cannot roll training back (process-0 writes only)
@@ -725,6 +821,19 @@ class Trainer:
                         except OSError:
                             pass
         finally:
+            # A crash mid-epoch must not lose the PREVIOUS epoch's
+            # completed eval + best-checkpoint gate (its results are
+            # already computed on device; the non-pipelined flow persisted
+            # them before starting the next epoch). Guarded so a fetch
+            # failure cannot mask the original exception.
+            if pending is not None:
+                try:
+                    finish(pending)
+                except Exception:
+                    log.exception(
+                        "could not finalize epoch %d during unwind",
+                        pending[0],
+                    )
             # the newest best-state snapshot must be on disk before the
             # process can exit (async writer, maybe_checkpoint)
             self.flush_checkpoints()

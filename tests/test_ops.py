@@ -246,3 +246,42 @@ def test_dense_grouped_conv_gate():
         assert _conv_group_counts(run(2), x) == [1]  # cpg=16: boundary, expanded
     # without the switch nothing expands
     assert _conv_group_counts(run(8), x) == [8]
+
+
+# -- dma_row_gather (ops/dma_gather.py) -------------------------------------
+# Compiled-TPU exactness + the 0.74 ms vs 5.29 ms A/B are recorded in
+# BENCHMARKS.md round 3; CI pins semantics in interpret mode.
+
+
+def test_dma_row_gather_matches_take_interpret():
+    from pytorch_cifar_tpu.ops.dma_gather import dma_row_gather
+
+    rs = np.random.RandomState(0)
+    imgs = rs.randint(0, 256, size=(96, 32, 32, 3), dtype=np.uint8)
+    idx = rs.randint(0, 96, size=(128,)).astype(np.int32)
+    out = dma_row_gather(jnp.asarray(imgs), jnp.asarray(idx), interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.take(imgs, idx, axis=0)
+    )
+
+
+def test_dma_row_gather_block_rounding_interpret():
+    from pytorch_cifar_tpu.ops.dma_gather import dma_row_gather
+
+    rs = np.random.RandomState(1)
+    imgs = rs.rand(40, 8, 128).astype(np.float32)
+    # m > block and m not a multiple of 1024: falls back to one grid step
+    idx = rs.randint(0, 40, size=(72,)).astype(np.int32)
+    out = dma_row_gather(
+        jnp.asarray(imgs), jnp.asarray(idx), block=48, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out), imgs[idx])
+
+
+def test_dma_row_gather_rejects_untileable_rows():
+    from pytorch_cifar_tpu.ops.dma_gather import dma_row_gather
+
+    imgs = jnp.zeros((16, 7, 9), jnp.float32)  # 63 elems: not (k*8, 128)
+    idx = jnp.zeros((8,), jnp.int32)
+    with pytest.raises(ValueError, match="cannot tile"):
+        dma_row_gather(imgs, idx, interpret=True)
