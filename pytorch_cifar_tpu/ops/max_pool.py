@@ -8,25 +8,40 @@ pool-heavy models — profiled at 16.2 ms of GoogLeNet's 102.8 ms step
 XLA measure *slower* (33-35 ms — shifted W-axis reads break (8,128) tile
 alignment in HBM; BENCHMARKS.md negative results).
 
-The kernel-level fix: the forward records, per window, WHICH of its nine
-taps won (first maximum in row-major scan order — the same tie rule as
-select-and-scatter and cuDNN's MaxPoolGrad). The backward then becomes nine
-masked accumulations over VMEM-resident tiles — shifted reads of a tile
+The kernel-level approach: a separable forward — max_h(max_w(x)), exact
+including ties, see _fwd_kernel — records per position which of each
+1-D pass's three taps won (first maximum, the select-and-scatter /
+cuDNN MaxPoolGrad tie rule). The backward is then two 3-tap masked
+routing passes over VMEM-resident tiles — shifted reads of a tile
 already in VMEM are register traffic, not misaligned HBM loads.
 
 Status: NOT wired into the model zoo — ``models.common.max_pool`` stays
-on ``nn.max_pool``. Round-2 A/B on the v5e (``tools/pool_bench.py``,
-chained-call + D2H-sync protocol, (512,32,32,480) bf16 fwd+bwd):
-**Pallas 22.2 ms vs XLA select-and-scatter 11.0 ms** — the rewrite
-recovered 16 ms over round 1's 38.1 ms (HBM pre-pads + int32 map
-eliminated) but the body is VPU-bound: every shifted W-slice of the
-VMEM-padded (34,34) tile is a sublane-misaligned read, and Mosaic
-rejects both bf16 compares ("Target does not support this comparison")
-and mixed-dtype masks, forcing f32 widening. Channel-block sweep
-128/256/512 is within noise, confirming compute-bound. Correctness is
-pinned by ``tests/test_ops.py`` (interpret-mode exact fp32 gradient
-equality with select-and-scatter) so future Mosaic work starts from a
-correct 22 ms baseline, 2x from parity.
+on ``nn.max_pool``. Round-3 closure (BENCHMARKS.md round 3 for the full
+evidence chain): the kernel was rewritten around an EXACT separable
+decomposition — max3x3 = max_h(max_w(x)), and the row-major-first-max
+tie rule survives the composition (first winning row, then first
+winning column, IS the row-major argmax) — cutting the 9-tap window to
+two 3-tap passes. Measured (512,32,32,480) bf16 fwd+bwd: 22.2 -> 21.1 ms
+vs XLA select-and-scatter ~11 ms (pool_bench protocol; 8.3 ms
+chained-slope). The tap reduction barely moved it, and fp32 (native
+compares, no widening) measures WORSE (36.2 ms), which together isolate
+the binding constraint: every W-shifted read of a VMEM tile is a
+sublane-misaligned vector access that Mosaic lowers as
+load+load+funnel-shift per vreg — the cost is per shifted ACCESS, not
+per tap mask, and no addressing mode folds the shift into the load.
+XLA's fused select-and-scatter keeps a ~2x advantage from specialized
+window primitives. Secondary Mosaic walls, still standing from round 2:
+bf16 compares rejected ("Target does not support this comparison"),
+4-D i1 masks with batch-block > 1 fail relayout. An XLA-level separable
+rewrite (two 1-D ``nn.max_pool``s; same exactness proof) was also
+measured: 8.13 vs 8.28 ms — a 2% non-win, select-and-scatter cost does
+not scale with window size. Model-level context: GoogLeNet's pools are
+17.75 ms of its 104.7 ms step (avg-pool-swap ablation), so even free
+pools leave it at 5.9k img/s — under the 6k round-1 target; its
+remaining wall is low-channel conv MXU utilization, not pools.
+Correctness of this kernel is pinned by ``tests/test_ops.py``
+(interpret-mode bit-exact routing vs select-and-scatter with
+integer cotangents, plus an all-ties tie-rule test).
 
 Round-2 rewrite (vs the round-1 version measured at 38.1 ms against XLA's
 12.0 ms at (512,32,32,480) bf16 fwd+bwd):
@@ -57,60 +72,90 @@ from pytorch_cifar_tpu.ops.blocking import batch_chunk, channel_chunk, pad_chann
 _NEG = float("-inf")
 
 
-def _fwd_kernel(x_ref, out_ref, idx_ref=None, *, h, w):
-    # x_ref: (nb, h, w, c) unpadded input tile; out/idx: (nb, h, w, c).
-    # idx_ref is None for the forward-only (inference) variant — the winner
-    # map is only needed to route gradients.
+def _fwd_kernel(x_ref, out_ref, ih_ref=None, iw_ref=None, *, h, w):
+    # x_ref: (nb, h, w, c) unpadded input tile; out/ih/iw: (nb, h, w, c).
+    # ih/iw are None for the forward-only (inference) variant — the winner
+    # maps are only needed to route gradients.
     #
-    # The winner map is kept in the INPUT dtype (0..8 are exact in bf16):
+    # SEPARABLE decomposition (round 3): a 3x3/s1 max pool is
+    # max_h(max_w(x)), and the select-and-scatter tie rule (row-major
+    # FIRST maximum — cuDNN MaxPoolGrad's rule) survives it exactly: the
+    # first row containing the window max, then the first column within
+    # that row, IS the row-major argmax. Two 3-tap passes replace the
+    # 9-tap window: 2/3 fewer masked ops, and only the w-pass touches
+    # sublane-misaligned shifted reads (the round-2 kernel's measured VPU
+    # bound — all six off-column taps were misaligned).
+    #
+    # The winner maps stay in the INPUT dtype (0..2 exact in bf16):
     # mixing dtype families inside the kernel (bf16 compares driving int8
     # selects) produces i1 masks in incompatible Mosaic layouts —
-    # "Invalid relayout ... xi1: (16,128) -> (32,128)" — while a single
-    # dtype family keeps every mask/select in one layout.
+    # "Invalid relayout ... xi1: (16,128) -> (32,128)".
     # f32 in-register compute: Mosaic rejects bf16 compares on this target
     # ("Target does not support this comparison"); the conversions are VPU
     # register traffic, while loads/stores stay in the input dtype so the
     # HBM side keeps the bandwidth win.
     x = x_ref[...].astype(jnp.float32)
-    xp = jnp.pad(
-        x, [(0, 0), (1, 1), (1, 1), (0, 0)], constant_values=_NEG
+    xpw = jnp.pad(
+        x, [(0, 0), (0, 0), (1, 1), (0, 0)], constant_values=_NEG
     )  # VMEM-local halo, not an HBM copy
-    best = xp[:, 0:h, 0:w, :]
-    idx = (
-        jnp.zeros(best.shape, jnp.float32) if idx_ref is not None else None
+    # w-pass: mh[i,j] = max over x[i, j-1..j+1], iw = first winning tap
+    mh = xpw[:, :, 0:w, :]
+    iw = jnp.zeros(mh.shape, jnp.float32) if iw_ref is not None else None
+    for k in range(1, 3):
+        cur = xpw[:, :, k : k + w, :]
+        m = cur > mh  # strict: earlier tap keeps ties
+        if iw is not None:
+            iw = jnp.where(m, jnp.float32(k), iw)
+        mh = jnp.where(m, cur, mh)
+    # h-pass over the intermediate: out[i,j] = max over mh[i-1..i+1, j]
+    mhp = jnp.pad(
+        mh, [(0, 0), (1, 1), (0, 0), (0, 0)], constant_values=_NEG
     )
-    for k in range(1, 9):
-        ky, kx = divmod(k, 3)
-        cur = xp[:, ky : ky + h, kx : kx + w, :]
-        m = cur > best  # strict: earlier (row-major) tap keeps ties
-        if idx_ref is not None:
-            idx = jnp.where(m, jnp.float32(k), idx)
+    best = mhp[:, 0:h, :, :]
+    ih = jnp.zeros(best.shape, jnp.float32) if ih_ref is not None else None
+    for k in range(1, 3):
+        cur = mhp[:, k : k + h, :, :]
+        m = cur > best
+        if ih is not None:
+            ih = jnp.where(m, jnp.float32(k), ih)
         best = jnp.where(m, cur, best)
     out_ref[...] = best.astype(out_ref.dtype)
-    if idx_ref is not None:
-        idx_ref[...] = idx.astype(idx_ref.dtype)
+    if ih_ref is not None:
+        ih_ref[...] = ih.astype(ih_ref.dtype)
+        iw_ref[...] = iw.astype(iw_ref.dtype)
 
 
-def _bwd_kernel(g_ref, i_ref, gi_ref, *, h, w):
-    # g/i: (nb, h, w, c) unpadded window-grad and winner-index tiles.
-    # Input position p receives window (p - k + 1)'s gradient iff that
-    # window's winner index equals k: gi[p] = sum_k [i'[k] == k] * g'[k]
-    # with the shifted slice [2-ky : 2-ky+h, 2-kx : 2-kx+w] of the
-    # VMEM-padded tiles (pad value 9 can never match a real tap index).
+def _bwd_kernel(g_ref, ih_ref, iw_ref, gi_ref, *, h, w):
+    # Two 3-tap routing passes, mirroring the separable forward.
+    # h-pass: intermediate position (i',j) receives window (i'-k+1, j)'s
+    # gradient iff that window's h-winner equals k (pad value 3 can never
+    # match a real tap). Then the w-pass routes the intermediate to the
+    # input column the w-winner picked. Only the w-pass reads shifted
+    # (sublane-misaligned) slices.
     g = g_ref[...].astype(jnp.float32)
-    gp = jnp.pad(g, [(0, 0), (1, 1), (1, 1), (0, 0)])
-    ip = jnp.pad(
-        i_ref[...].astype(jnp.float32),
-        [(0, 0), (1, 1), (1, 1), (0, 0)],
-        constant_values=9.0,
+    gp = jnp.pad(g, [(0, 0), (1, 1), (0, 0), (0, 0)])
+    ihp = jnp.pad(
+        ih_ref[...].astype(jnp.float32),
+        [(0, 0), (1, 1), (0, 0), (0, 0)],
+        constant_values=3.0,
+    )
+    gmh = None
+    for k in range(3):
+        sl_h = slice(2 - k, 2 - k + h)
+        hit = ihp[:, sl_h, :, :] == jnp.float32(k)
+        term = jnp.where(hit, gp[:, sl_h, :, :], jnp.float32(0))
+        gmh = term if gmh is None else gmh + term
+    gmhp = jnp.pad(gmh, [(0, 0), (0, 0), (1, 1), (0, 0)])
+    iwp = jnp.pad(
+        iw_ref[...].astype(jnp.float32),
+        [(0, 0), (0, 0), (1, 1), (0, 0)],
+        constant_values=3.0,
     )
     acc = None
-    for k in range(9):
-        ky, kx = divmod(k, 3)
-        sl_h = slice(2 - ky, 2 - ky + h)
-        sl_w = slice(2 - kx, 2 - kx + w)
-        hit = ip[:, sl_h, sl_w, :] == jnp.float32(k)
-        term = jnp.where(hit, gp[:, sl_h, sl_w, :], jnp.float32(0))
+    for k in range(3):
+        sl_w = slice(2 - k, 2 - k + w)
+        hit = iwp[:, :, sl_w, :] == jnp.float32(k)
+        term = jnp.where(hit, gmhp[:, :, sl_w, :], jnp.float32(0))
         acc = term if acc is None else acc + term
     gi_ref[...] = acc.astype(gi_ref.dtype)
 
@@ -150,18 +195,19 @@ def _max_pool3x3_fwd(x, interpret=False, emit_idx=True):
     out_spec = _spec((nb, h, w, cb))
     out_shape = jax.ShapeDtypeStruct((n, h, w, cp), x.dtype)
     if emit_idx:
-        out, idx = pl.pallas_call(
+        out, ih, iw = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[_spec((nb, h, w, cb))],
-            out_specs=(out_spec, _spec((nb, h, w, cb))),
+            out_specs=(out_spec, _spec((nb, h, w, cb)), _spec((nb, h, w, cb))),
             out_shape=(
                 out_shape,
+                jax.ShapeDtypeStruct((n, h, w, cp), x.dtype),
                 jax.ShapeDtypeStruct((n, h, w, cp), x.dtype),
             ),
             interpret=interpret,
         )(x)
-        return out[..., :c], idx[..., :c]
+        return out[..., :c], (ih[..., :c], iw[..., :c])
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -174,22 +220,27 @@ def _max_pool3x3_fwd(x, interpret=False, emit_idx=True):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _max_pool3x3_bwd(g, idx, interpret=False):
+def _max_pool3x3_bwd(g, ih, iw, interpret=False):
     n, h, w, _ = g.shape
     cb = _chunk(g.shape[-1])
     g, c = _pad_channels(g, cb)
-    idx, _ = _pad_channels(idx, cb)
+    ih, _ = _pad_channels(ih, cb)
+    iw, _ = _pad_channels(iw, cb)
     cp = g.shape[-1]
     nb = _batch_chunk(n)
     kernel = functools.partial(_bwd_kernel, h=h, w=w)
     out = pl.pallas_call(
         kernel,
         grid=(n // nb, cp // cb),
-        in_specs=[_spec((nb, h, w, cb)), _spec((nb, h, w, cb))],
+        in_specs=[
+            _spec((nb, h, w, cb)),
+            _spec((nb, h, w, cb)),
+            _spec((nb, h, w, cb)),
+        ],
         out_specs=_spec((nb, h, w, cb)),
         out_shape=jax.ShapeDtypeStruct((n, h, w, cp), g.dtype),
         interpret=interpret,
-    )(g, idx)
+    )(g, ih, iw)
     return out[..., :c]
 
 
@@ -207,7 +258,8 @@ def _vjp_fwd(x, interpret):
 
 
 def _vjp_bwd(interpret, idx, g):
-    return (_max_pool3x3_bwd(g, idx, interpret=interpret),)
+    ih, iw = idx
+    return (_max_pool3x3_bwd(g, ih, iw, interpret=interpret),)
 
 
 max_pool3x3_s1.defvjp(_vjp_fwd, _vjp_bwd)

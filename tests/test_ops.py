@@ -97,12 +97,46 @@ def test_max_pool3x3_forward_nonaligned_channels():
 def test_max_pool3x3_gradient_matches_select_and_scatter():
     from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
 
-    # fp32 random data has no ties: the first-max routing must reproduce
-    # XLA's select-and-scatter gradient EXACTLY
+    # fp32 random data has no ties, and integer-valued cotangents make
+    # every per-position gradient sum EXACT in any association order (the
+    # separable two-pass backward sums window grads kx-major while XLA's
+    # select-and-scatter sums ky-major — same route set, different fp
+    # rounding on random floats): the first-max routing must reproduce
+    # XLA's gradient bit-exactly
     x = jax.random.normal(jax.random.PRNGKey(5), (3, 8, 8, 16))
-    g_ref = jax.grad(lambda x: (_xla_pool(x) ** 2).sum())(x)
-    g_new = jax.grad(lambda x: (max_pool3x3_s1(x, True) ** 2).sum())(x)
-    np.testing.assert_array_equal(np.asarray(g_new), np.asarray(g_ref))
+    g = jnp.round(
+        jax.random.uniform(jax.random.PRNGKey(7), x.shape) * 8.0
+    )
+    _, vjp_ref = jax.vjp(_xla_pool, x)
+    _, vjp_new = jax.vjp(lambda x: max_pool3x3_s1(x, True), x)
+    np.testing.assert_array_equal(
+        np.asarray(vjp_new(g)[0]), np.asarray(vjp_ref(g)[0])
+    )
+    # float cotangents: same routes, reassociation-level tolerance only
+    gf = jax.random.normal(jax.random.PRNGKey(8), x.shape)
+    np.testing.assert_allclose(
+        np.asarray(vjp_new(gf)[0]),
+        np.asarray(vjp_ref(gf)[0]),
+        atol=1e-5,
+    )
+
+
+def test_max_pool3x3_gradient_tie_rule_first_max():
+    from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
+
+    # all-equal input: EVERY window tap ties, so the gradient routing is
+    # decided purely by the tie rule (row-major first maximum, the
+    # select-and-scatter / cuDNN rule). Integer cotangents keep the sums
+    # exact.
+    x = jnp.ones((2, 6, 6, 8), jnp.float32)
+    g = jnp.round(
+        jax.random.uniform(jax.random.PRNGKey(9), x.shape) * 8.0
+    )
+    _, vjp_ref = jax.vjp(_xla_pool, x)
+    _, vjp_new = jax.vjp(lambda x: max_pool3x3_s1(x, True), x)
+    np.testing.assert_array_equal(
+        np.asarray(vjp_new(g)[0]), np.asarray(vjp_ref(g)[0])
+    )
 
 
 def test_max_pool3x3_gradient_mass_conserved_bf16():
