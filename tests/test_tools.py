@@ -10,25 +10,30 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _run_tool(args, timeout=560):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable] + args,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+        check=True,
+    )
+
+
 def test_accuracy_run_wallclock_mode(tmp_path):
     """tools/accuracy_run.py --wallclock-only writes the summary JSON with
     honest-or-absent accuracy fields (synthetic runs must never report an
     'accuracy')."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    out = subprocess.run(
+    out = _run_tool(
         [
-            sys.executable,
             os.path.join(REPO, "tools", "accuracy_run.py"),
             "--model", "LeNet", "--epochs", "2", "--batch", "1024",
             "--wallclock-only", "--out", str(tmp_path / "wc"),
-        ],
-        capture_output=True,
-        text=True,
-        timeout=560,
-        cwd=REPO,
-        env=env,
-        check=True,
+        ]
     )
     with open(tmp_path / "wc" / "accuracy_run.json") as f:
         d = json.load(f)
@@ -41,3 +46,60 @@ def test_accuracy_run_wallclock_mode(tmp_path):
     assert d["history"][0]["train_loss"] > 0
     # stdout ends with the same summary JSON
     assert json.loads(out.stdout[out.stdout.index("{"):])["epochs_run"] == 2
+
+
+def test_zoo_bench_smoke(tmp_path):
+    """zoo_bench end-to-end on CPU: clamps, benches, writes the JSON
+    artifact this repo's family table is built from."""
+    out = _run_tool(
+        [
+            os.path.join(REPO, "tools", "zoo_bench.py"),
+            "--models", "LeNet", "--steps", "2", "--warmup", "1",
+            "--repeats", "1", "--out", str(tmp_path / "sweep.json"),
+        ]
+    )
+    with open(tmp_path / "sweep.json") as f:
+        d = json.load(f)
+    assert d["platform"] == "cpu"  # honor_platform_env held
+    res = d["results"]["LeNet"]
+    assert res["images_per_sec"] > 0
+    assert "LeNet" in out.stdout
+
+
+def test_step_cost_smoke():
+    """step_cost: XLA cost analysis + timing table for a model."""
+    out = _run_tool(
+        [
+            os.path.join(REPO, "tools", "step_cost.py"),
+            "--models", "LeNet", "--steps", "2",
+        ]
+    )
+    lines = [l for l in out.stdout.splitlines() if l.startswith("LeNet")]
+    assert lines, out.stdout
+    # the row carries GFLOP/step, ms, img/s columns — all nonzero numbers
+    cols = lines[0].split()
+    assert float(cols[1]) > 0 and float(cols[3]) > 0
+
+
+def test_pool_bench_smoke():
+    """pool_bench: interpret-mode Pallas vs XLA A/B, gradient check line."""
+    out = _run_tool(
+        [
+            os.path.join(REPO, "tools", "pool_bench.py"),
+            "--n", "2", "--h", "6", "--c", "16",
+            "--steps", "1", "--repeats", "1", "--dtype", "float32",
+        ]
+    )
+    assert "XLA(select-and-scatter)=" in out.stdout
+    assert "Pallas(winner-index)=" in out.stdout
+    # fp32 interpret mode: routing is exact (reassociation-level only)
+    err = float(out.stdout.split("max|dgrad|=")[1].split()[0])
+    assert err < 1e-4
+
+
+def test_bn_bench_smoke():
+    """bn_bench: fused-moments vs twin-reduce sweep runs end-to-end."""
+    out = _run_tool(
+        [os.path.join(REPO, "tools", "bn_bench.py")], timeout=560
+    )
+    assert "fused" in out.stdout.lower() or "moments" in out.stdout.lower()
