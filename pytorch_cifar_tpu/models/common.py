@@ -299,6 +299,20 @@ def bn_moments_impl(fn):
         _BN_MOMENTS_IMPL.reset(token)
 
 
+def bn_batch_moments(x):
+    """Per-channel batch ``(E[x], E[x^2])`` in fp32 — the quantities every
+    BatchNorm reduces, honoring a ``_BN_MOMENTS_IMPL`` override when one is
+    active. The single source for BN moment numerics: BatchNorm's inline
+    path and DenseNet's shared-stats chunk moments both call this, so the
+    two can never drift."""
+    impl = _BN_MOMENTS_IMPL.get()
+    if impl is not None:
+        return impl(x)
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    return jnp.mean(xf, axis=axes), jnp.mean(jnp.square(xf), axis=axes)
+
+
 class BatchNorm(nn.Module):
     """BatchNorm with torch-exact BatchNorm2d semantics.
 
@@ -325,7 +339,20 @@ class BatchNorm(nn.Module):
     epsilon: float = 1e-5
 
     @nn.compact
-    def __call__(self, x, use_running_average: Optional[bool] = None):
+    def __call__(
+        self,
+        x,
+        use_running_average: Optional[bool] = None,
+        moments=None,
+    ):
+        """``moments``: optional precomputed ``(E[x], E[x^2])`` per-channel
+        fp32 vectors. BN statistics are per-channel, so a caller that
+        already knows them — DenseNet's shared-stats path, where the
+        growing concat's moments are the concatenation of each chunk's
+        moments computed once at creation — can skip this layer's reduce
+        over the full input. Semantically identical to computing them
+        here (autodiff flows through the provided values); ignored in
+        eval mode and during init."""
         ura = nn.merge_param(
             "use_running_average", self.use_running_average, use_running_average
         )
@@ -347,9 +374,10 @@ class BatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
         else:
             axes = tuple(range(x.ndim - 1))
-            moments = _BN_MOMENTS_IMPL.get()
             if moments is not None and not self.is_initializing():
-                mean, sq = moments(x)
+                mean, sq = moments
+            elif not self.is_initializing():
+                mean, sq = bn_batch_moments(x)
             else:
                 xf = x.astype(jnp.float32)
                 mean = jnp.mean(xf, axis=axes)

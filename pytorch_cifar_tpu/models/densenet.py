@@ -28,18 +28,41 @@ from pytorch_cifar_tpu.models.common import (
 )
 
 
+def _chunk_moments(x):
+    """Per-channel (E[x], E[x^2]) of one produced feature chunk, computed
+    ONCE on the shared-stats path and reused by every later BN whose input
+    contains the chunk. Delegates to the shared BN moments helper so the
+    numerics (and any _BN_MOMENTS_IMPL override) cannot drift from the
+    per-layer path."""
+    from pytorch_cifar_tpu.models.common import bn_batch_moments
+
+    return bn_batch_moments(x)
+
+
 class DenseLayer(nn.Module):
     growth_rate: int
     dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, moments=None):
+        """``moments``: running per-channel (E[x], E[x^2]) of ``x`` on the
+        shared-stats path; returns (concat, updated moments) when given.
+        BN stats are per-channel and channels partition into the chunks
+        that produced them, so concatenated chunk moments ARE the concat's
+        moments — exactly, not approximately."""
         bn = partial(BatchNorm, use_running_average=not train, dtype=self.dtype)
-        out = nn.relu(bn()(x))
+        out = nn.relu(bn()(x, moments=moments))
         out = Conv(4 * self.growth_rate, 1, use_bias=False, dtype=self.dtype)(out)
         out = nn.relu(bn()(out))
         out = Conv(self.growth_rate, 3, padding=1, use_bias=False, dtype=self.dtype)(out)
-        return jnp.concatenate([out, x], axis=-1)
+        if moments is None:
+            return jnp.concatenate([out, x], axis=-1)
+        m, sq = _chunk_moments(out)
+        new_moments = (
+            jnp.concatenate([m, moments[0]]),
+            jnp.concatenate([sq, moments[1]]),
+        )
+        return jnp.concatenate([out, x], axis=-1), new_moments
 
 
 class Transition(nn.Module):
@@ -47,32 +70,60 @@ class Transition(nn.Module):
     dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x, train: bool):
-        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+    def __call__(self, x, train: bool, moments=None):
+        x = nn.relu(
+            BatchNorm(use_running_average=not train, dtype=self.dtype)(
+                x, moments=moments
+            )
+        )
         x = Conv(self.out_planes, 1, use_bias=False, dtype=self.dtype)(x)
         return avg_pool(x, 2)
 
 
 class DenseNet(nn.Module):
+    """``shared_stats=True`` (train-mode only) computes each produced
+    chunk's BN moments once and reuses them in every later layer whose BN
+    covers the chunk, eliminating the per-layer reduce over the growing
+    prefix — the round-1-profiled dominant HBM cost of this family. The
+    parameter/stat tree and the math are unchanged (per-channel moments
+    concatenate exactly); only reduce scheduling differs."""
+
     nblocks: Sequence[int]
     growth_rate: int = 12
     reduction: float = 0.5
     num_classes: int = 10
     dtype: Optional[Any] = None
+    shared_stats: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         g = self.growth_rate
         planes = 2 * g
+        shared = self.shared_stats and train
         x = Conv(planes, 3, padding=1, use_bias=False, dtype=self.dtype)(x)
+        moments = _chunk_moments(x) if shared else None
         for stage, nblock in enumerate(self.nblocks):
             for _ in range(nblock):
-                x = DenseLayer(g, dtype=self.dtype)(x, train)
+                if shared:
+                    x, moments = DenseLayer(g, dtype=self.dtype)(
+                        x, train, moments=moments
+                    )
+                else:
+                    x = DenseLayer(g, dtype=self.dtype)(x, train)
             planes += nblock * g
             if stage < len(self.nblocks) - 1:
                 planes = int(math.floor(planes * self.reduction))
-                x = Transition(planes, dtype=self.dtype)(x, train)
-        x = nn.relu(BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+                x = Transition(planes, dtype=self.dtype)(
+                    x, train, moments=moments
+                )
+                # the transition's conv+pool output is a fresh tensor: the
+                # stack (and its moments) restart from one new chunk
+                moments = _chunk_moments(x) if shared else None
+        x = nn.relu(
+            BatchNorm(use_running_average=not train, dtype=self.dtype)(
+                x, moments=moments
+            )
+        )
         x = avg_pool(x, 4)
         x = x.reshape((x.shape[0], -1))
         return Dense(self.num_classes, dtype=self.dtype)(x)

@@ -169,3 +169,52 @@ def test_registry_contains_all_models():
 def test_unknown_model_raises():
     with pytest.raises(KeyError):
         create_model("NotAModel")
+
+
+def test_densenet_shared_stats_matches_stock():
+    """DenseNet's shared-stats path (chunk moments computed once,
+    concatenated per layer) must match the stock per-layer reduce:
+    outputs, parameter gradients, AND updated running stats — the
+    per-channel moments of a concat ARE the concatenation of its chunks'
+    moments, so this is a scheduling change, not a numerics change."""
+    from pytorch_cifar_tpu.models.densenet import DenseNet
+
+    import jax
+
+    stock = DenseNet((2, 2), growth_rate=8, shared_stats=False)
+    shared = DenseNet((2, 2), growth_rate=8, shared_stats=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32, 32, 3))
+    variables = stock.init(jax.random.PRNGKey(1), x, train=False)
+
+    def run(model):
+        def loss_fn(params):
+            out, mut = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return (out.astype(jnp.float32) ** 2).sum(), mut["batch_stats"]
+
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            variables["params"]
+        )
+        return loss, stats, grads
+
+    l1, s1, g1 = run(stock)
+    l2, s2, g2 = run(shared)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1), jax.tree_util.tree_leaves(s2)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+    # eval path is byte-identical code (shared only engages in train mode)
+    e1 = stock.apply(variables, x, train=False)
+    e2 = shared.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
