@@ -1,0 +1,107 @@
+"""Round-3 structural A/Bs for the bandwidth-roofed families (VERDICT #8).
+
+A: DenseNet121 stock vs ``shared_stats=True`` (chunk BN moments computed
+   once per produced chunk instead of a per-layer reduce over the growing
+   prefix — exact, tests/test_models.py).
+B: DPN92 stock vs ``--dense_grouped_conv`` (its first three stages have
+   3/6/12 channels per group — inside the gate the round-2 ResNeXt win
+   established; stage 4 at 24 cpg stays native).
+
+Protocol: the headline chained protocol (donated state, D2H metric sync,
+best-of blocks). Prints one line per arm.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.models.common import set_dense_grouped_conv
+    from pytorch_cifar_tpu.models.densenet import DenseNet
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+    from pytorch_cifar_tpu.train.steps import make_train_step
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--warmup", type=int, default=10)
+    parser.add_argument("--repeats", type=int, default=2)
+    args = parser.parse_args()
+
+    from bench import clamp_for_cpu
+
+    clamp_for_cpu(args)
+
+    def bench_model(model):
+        tx = make_optimizer(lr=1e-3, t_max=200, steps_per_epoch=98)
+        state = create_train_state(model, jax.random.PRNGKey(0), tx)
+        step = jax.jit(
+            make_train_step(compute_dtype=jnp.bfloat16), donate_argnums=(0,)
+        )
+        rs = np.random.RandomState(0)
+        x = jax.device_put(
+            rs.randint(0, 256, size=(args.batch, 32, 32, 3), dtype=np.uint8)
+        )
+        y = jax.device_put(
+            rs.randint(0, 10, size=(args.batch,)).astype(np.int32)
+        )
+        rng = jax.random.PRNGKey(42)
+        m = None
+        for _ in range(args.warmup):
+            state, m = step(state, (x, y), rng)
+        float(m["loss_sum"])
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, m = step(state, (x, y), rng)
+            float(m["loss_sum"])
+            best = min(best, time.perf_counter() - t0)
+        ms = best / args.steps * 1e3
+        return ms, args.batch * args.steps / best
+
+    # shared_stats defaults to True since round 3 — the stock arm must
+    # force it off or this tool compares shared vs shared
+    for name, model in (
+        (
+            "DenseNet121 stock      ",
+            DenseNet((6, 12, 24, 16), 32, dtype=jnp.bfloat16, shared_stats=False),
+        ),
+        (
+            "DenseNet121 shared_bn  ",
+            DenseNet((6, 12, 24, 16), 32, dtype=jnp.bfloat16, shared_stats=True),
+        ),
+    ):
+        ms, rate = bench_model(model)
+        print(f"{name}: {ms:7.2f} ms/step {rate:9.0f} img/s", flush=True)
+
+    for name, dense in (("DPN92 stock            ", False),
+                        ("DPN92 dense_grouped    ", True)):
+        set_dense_grouped_conv(dense)
+        try:
+            ms, rate = bench_model(create_model("DPN92", dtype=jnp.bfloat16))
+        finally:
+            set_dense_grouped_conv(False)
+        print(f"{name}: {ms:7.2f} ms/step {rate:9.0f} img/s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
