@@ -319,3 +319,43 @@ def test_dma_row_gather_rejects_untileable_rows():
     idx = jnp.zeros((8,), jnp.int32)
     with pytest.raises(ValueError, match="cannot tile"):
         dma_row_gather(imgs, idx, interpret=True)
+
+
+def test_dma_gather_wired_into_epoch_fn_jaxpr():
+    """make_train_epoch(dma_gather=True) must actually route the epoch
+    shuffle through the Pallas kernel (trace-level check — the kernel
+    only compiles on TPU, but the pallas primitive is visible in the
+    jaxpr on any platform), and dma_gather=False must not."""
+    from pytorch_cifar_tpu.train.steps import (
+        make_train_epoch,
+        make_train_step,
+        zero_metrics,
+    )
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.1, t_max=2, steps_per_epoch=2)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    images = jnp.zeros((64, 32, 32, 3), jnp.uint8)
+    labels = jnp.zeros((64,), jnp.int32)
+    perm = jnp.arange(64, dtype=jnp.int32)
+
+    def jaxpr_for(dma):
+        fn = make_train_epoch(
+            make_train_step(augment=False),
+            global_batch=32,
+            n_data=64,
+            num_steps=2,
+            dma_gather=dma,
+        )
+        return str(
+            jax.make_jaxpr(fn)(
+                state, zero_metrics(), images, labels, perm,
+                jax.random.PRNGKey(0),
+            )
+        )
+
+    assert "pallas_call" in jaxpr_for(True)
+    assert "pallas_call" not in jaxpr_for(False)

@@ -282,3 +282,40 @@ def test_stale_preemption_save_not_preferred(tmp_path):
     tr3 = Trainer(small_config(tmp_path, epochs=9, resume=True))
     assert tr3.start_epoch == 6
     assert tr3.best_acc == 51.0
+
+
+def test_pipelined_fit_finalizes_pending_epoch_on_crash(tmp_path):
+    """fit() pipelines epochs: epoch e's eval/checkpoint gate runs after
+    epoch e+1 is dispatched. A crash during the NEXT dispatch — while
+    epoch 0 is still pending, before any in-loop finalization has ever
+    run — must finalize the pending epoch during unwind (fetch its
+    metrics, write its best checkpoint); otherwise the completed epoch's
+    best model is silently lost (round-3 review finding, fixed in fit's
+    finally). Without the fix nothing at all has been checkpointed at
+    crash time, so the assertions below fail."""
+    cfg = small_config(
+        tmp_path,
+        epochs=4,
+        synthetic_train_size=64,
+        synthetic_test_size=32,
+        batch_size=32,
+    )
+    tr = Trainer(cfg)
+    assert tr.train_epoch_fn is not None  # pipelined path active
+
+    real_dispatch = tr._dispatch_train_epoch
+    calls = {"n": 0}
+
+    def failing_dispatch(epoch):
+        calls["n"] += 1
+        if calls["n"] == 2:  # epoch 0 dispatches; epoch 1's dispatch dies
+            raise RuntimeError("injected dispatch failure")
+        return real_dispatch(epoch)
+
+    tr._dispatch_train_epoch = failing_dispatch
+    with pytest.raises(RuntimeError, match="injected dispatch failure"):
+        tr.fit()
+    # epoch 0 was pending (dispatched, never finalized in-loop) at crash
+    # time; unwind must have fetched its eval and written its checkpoint
+    assert tr.best_acc > 0
+    assert os.path.exists(os.path.join(cfg.output_dir, "ckpt.msgpack"))
