@@ -262,6 +262,66 @@ class Conv(nn.Module):
         )(x)
 
 
+class _ConvParamLeaf(nn.Module):
+    """Creates nn.Conv-identical ``kernel``/``bias`` params without
+    convolving; the innermost half of :class:`ConvParams`."""
+
+    features: int
+    ks: Tuple[int, int]
+    in_ch: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self):
+        fan_in = self.ks[0] * self.ks[1] * self.in_ch
+        kernel = self.param(
+            "kernel",
+            torch_conv_kernel_init,
+            (self.ks[0], self.ks[1], self.in_ch, self.features),
+            jnp.float32,
+        )
+        bias = (
+            self.param(
+                "bias", torch_conv_bias_init(fan_in), (self.features,),
+                jnp.float32,
+            )
+            if self.use_bias
+            else None
+        )
+        return kernel, bias
+
+
+class ConvParams(nn.Module):
+    """Param-path twin of :class:`Conv` (ungrouped): creates
+    ``.../<name>/Conv_0/{kernel,bias}`` with identical shapes and init but
+    returns the arrays instead of convolving.
+
+    Lets a caller execute several same-input convs as ONE wider conv while
+    keeping the param tree bit-identical to the stock modules — each output
+    channel of a conv is an independent dot product over the input, so
+    ``conv(x, concat(k1, k2))`` equals ``concat(conv(x, k1), conv(x, k2))``
+    exactly. Used by GoogLeNet's merged-branch Inception path
+    (models/googlenet.py); init values match the stock path because flax
+    derives param RNG keys from the scope path, which is unchanged.
+    """
+
+    features: int
+    kernel_size: Union[int, Tuple[int, int]]
+    in_ch: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self):
+        ks = (
+            (self.kernel_size, self.kernel_size)
+            if isinstance(self.kernel_size, int)
+            else tuple(self.kernel_size)
+        )
+        return _ConvParamLeaf(
+            self.features, ks, self.in_ch, self.use_bias, name="Conv_0"
+        )()
+
+
 class Dense(nn.Module):
     """Linear layer with PyTorch-default init."""
 

@@ -9,20 +9,36 @@ branch convs keep their bias (torch default). Stem is conv3x3(3->192)+BN+ReLU
 (models/googlenet.py:79-80).
 
 Golden param count: 6,166,250.
+
+``merged_1x1`` (DEFAULT ON) executes the three same-input 1x1 convs of
+each cell (the 1x1 branch and the two reduce convs) as ONE conv of width
+``n1x1+n3x3red+n5x5red``, with one BN-moments reduce over the merged
+output. Exact, not approximate: each conv output channel is an
+independent dot product, and BN statistics are per-channel, so the merged
+activations/moments are the concatenation of the per-branch ones. The
+param tree is bit-identical to the stock path (ConvParams twins +
+explicit module names), so checkpoints, golden counts, and torch
+transplants are unaffected; ``merged_1x1=False`` restores the literal
+per-branch execution. Motivation: the narrow reduce convs (16-48
+channels) starve the 128-wide MXU lanes — the same structural waste
+class as ResNeXt's narrow groups (BENCHMARKS.md round 3).
 """
 
 from __future__ import annotations
 
 from typing import Any, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from pytorch_cifar_tpu.models.common import (
     BatchNorm,
     Conv,
+    ConvParams,
     Dense,
     avg_pool,
+    bn_batch_moments,
     max_pool,
 )
 
@@ -37,27 +53,129 @@ class Inception(nn.Module):
     n5x5: int
     pool_planes: int
     dtype: Optional[Any] = None
+    merged_1x1: bool = True
+    merged_3x3: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
-        def cbr(h, features, kernel, padding=0):
-            h = Conv(features, kernel, padding=padding, dtype=self.dtype)(h)
-            h = BatchNorm(use_running_average=not train, dtype=self.dtype)(h)
+        def cbr(h, features, kernel, conv_name, bn_name, padding=0):
+            h = Conv(
+                features, kernel, padding=padding, dtype=self.dtype,
+                name=conv_name,
+            )(h)
+            h = BatchNorm(
+                use_running_average=not train, dtype=self.dtype, name=bn_name
+            )(h)
             return nn.relu(h)
 
-        y1 = cbr(x, self.n1x1, 1)
-
-        y2 = cbr(x, self.n3x3red, 1)
-        y2 = cbr(y2, self.n3x3, 3, padding=1)
-
-        y3 = cbr(x, self.n5x5red, 1)
-        y3 = cbr(y3, self.n5x5, 3, padding=1)
-        y3 = cbr(y3, self.n5x5, 3, padding=1)
+        # explicit names == the stock path's auto-assigned ones, so both
+        # modes build the same param tree; the stock path keeps the full
+        # per-branch CALL order (y1, y2, y3, y4 — torch definition order,
+        # which tests/test_torch_parity.py aligns against)
+        if self.merged_1x1:
+            y1, y2, y3 = self._merged_heads(x, train)
+            if self.merged_3x3:
+                y2, y3 = self._merged_mid(y2, y3, train)
+            else:
+                y2 = cbr(y2, self.n3x3, 3, "Conv_2", "BatchNorm_2", padding=1)
+                y3 = cbr(y3, self.n5x5, 3, "Conv_4", "BatchNorm_4", padding=1)
+            y3 = cbr(y3, self.n5x5, 3, "Conv_5", "BatchNorm_5", padding=1)
+        else:
+            y1 = cbr(x, self.n1x1, 1, "Conv_0", "BatchNorm_0")
+            y2 = cbr(x, self.n3x3red, 1, "Conv_1", "BatchNorm_1")
+            y2 = cbr(y2, self.n3x3, 3, "Conv_2", "BatchNorm_2", padding=1)
+            y3 = cbr(x, self.n5x5red, 1, "Conv_3", "BatchNorm_3")
+            y3 = cbr(y3, self.n5x5, 3, "Conv_4", "BatchNorm_4", padding=1)
+            y3 = cbr(y3, self.n5x5, 3, "Conv_5", "BatchNorm_5", padding=1)
 
         y4 = max_pool(x, 3, stride=1, padding=1)
-        y4 = cbr(y4, self.pool_planes, 1)
+        y4 = cbr(y4, self.pool_planes, 1, "Conv_6", "BatchNorm_6")
 
         return jnp.concatenate([y1, y2, y3, y4], axis=-1)
+
+    def _merged_conv_bn(self, x, kernel, bias, widths, bn_names, pad, train):
+        """One conv over the merged kernel, one BN-moments reduce, then
+        per-branch slice + BatchNorm + relu. Shared tail of both merged
+        paths so their moments/BN wiring cannot drift."""
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype
+        )
+        h = (
+            jax.lax.conv_general_dilated(
+                x,
+                kernel,
+                window_strides=(1, 1),
+                padding=[(pad, pad), (pad, pad)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            + bias
+        )
+        moments = None
+        if train and not self.is_initializing():
+            moments = bn_batch_moments(h)
+        outs = []
+        offset = 0
+        for feats, bn_name in zip(widths, bn_names):
+            m = None
+            if moments is not None:
+                m = (
+                    moments[0][offset : offset + feats],
+                    moments[1][offset : offset + feats],
+                )
+            outs.append(
+                nn.relu(
+                    BatchNorm(
+                        use_running_average=not train,
+                        dtype=self.dtype,
+                        name=bn_name,
+                    )(h[..., offset : offset + feats], moments=m)
+                )
+            )
+            offset += feats
+        return tuple(outs)
+
+    def _merged_heads(self, x, train: bool):
+        """The three same-input 1x1 conv+BN+relu heads as one conv + one
+        moments reduce, sliced back apart for their per-branch BNs."""
+        widths = (self.n1x1, self.n3x3red, self.n5x5red)
+        cin = x.shape[-1]
+        parts = [
+            ConvParams(f, 1, cin, name=n)()
+            for f, n in zip(widths, ("Conv_0", "Conv_1", "Conv_3"))
+        ]
+        kernel = jnp.concatenate([k for k, _ in parts], axis=-1)
+        bias = jnp.concatenate([b for _, b in parts])
+        return self._merged_conv_bn(
+            x, kernel, bias, widths,
+            ("BatchNorm_0", "BatchNorm_1", "BatchNorm_3"), 0, train,
+        )
+
+    def _merged_mid(self, y2, y3, train: bool):
+        """The y2 3x3 (n3x3red->n3x3) and y3 first 3x3 (n5x5red->n5x5) as
+        ONE block-diagonal dense conv over their concatenated inputs.
+
+        The off-diagonal kernel blocks are exact zeros, so the extra
+        accumulation terms are exact zeros — numerics unchanged (the same
+        argument as common.py's dense grouped-conv expansion). Spends
+        ~1.4-1.6x the FLOPs of the two separate convs to put the narrow
+        n5x5 outputs (32-128 channels) on full 128-wide MXU lanes."""
+        r1, r2 = self.n3x3red, self.n5x5red
+        o1, o2 = self.n3x3, self.n5x5
+        k2, b2 = ConvParams(o1, 3, r1, name="Conv_2")()
+        k4, b4 = ConvParams(o2, 3, r2, name="Conv_4")()
+        top = jnp.concatenate(
+            [k2, jnp.zeros((3, 3, r1, o2), k2.dtype)], axis=-1
+        )
+        bot = jnp.concatenate(
+            [jnp.zeros((3, 3, r2, o1), k4.dtype), k4], axis=-1
+        )
+        kernel = jnp.concatenate([top, bot], axis=2)
+        bias = jnp.concatenate([b2, b4])
+        z = jnp.concatenate([y2, y3], axis=-1)
+        return self._merged_conv_bn(
+            z, kernel, bias, (o1, o2),
+            ("BatchNorm_2", "BatchNorm_4"), 1, train,
+        )
 
 
 # (n1x1, n3x3red, n3x3, n5x5red, n5x5, pool_planes) per cell, in call order;
@@ -80,6 +198,8 @@ _CELLS: Tuple = (
 class GoogLeNet(nn.Module):
     num_classes: int = 10
     dtype: Optional[Any] = None
+    merged_1x1: bool = True
+    merged_3x3: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -89,7 +209,12 @@ class GoogLeNet(nn.Module):
             if cell is None:
                 x = max_pool(x, 3, stride=2, padding=1)
             else:
-                x = Inception(*cell, dtype=self.dtype)(x, train)
+                x = Inception(
+                    *cell,
+                    dtype=self.dtype,
+                    merged_1x1=self.merged_1x1,
+                    merged_3x3=self.merged_3x3,
+                )(x, train)
         x = avg_pool(x, 8, stride=1)
         x = x.reshape((x.shape[0], -1))
         return Dense(self.num_classes, dtype=self.dtype)(x)

@@ -218,3 +218,77 @@ def test_densenet_shared_stats_matches_stock():
     e1 = stock.apply(variables, x, train=False)
     e2 = shared.apply(variables, x, train=False)
     np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+
+def test_googlenet_merged_1x1_matches_stock():
+    """GoogLeNet's merged-branch path (the cell's three same-input 1x1
+    convs executed as one wider conv + one BN-moments reduce) must match
+    the stock per-branch execution: identical param tree with bit-equal
+    init (ConvParams twins share the stock modules' scope paths, and flax
+    derives init RNG from the path), and equal outputs, parameter
+    gradients, and updated running stats — per-output-channel conv math
+    and per-channel BN statistics are both independent across channels,
+    so the merge is a scheduling change, not a numerics change."""
+    from pytorch_cifar_tpu.models.googlenet import Inception
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 64))
+    stock = Inception(64, 96, 128, 16, 32, 32, merged_1x1=False)
+    merged = Inception(64, 96, 128, 16, 32, 32, merged_1x1=True)
+    # merged_3x3 (block-diagonal level-2 conv) is a measured perf negative
+    # on the v5e (BENCHMARKS.md round 3) but stays covered here so the
+    # documented path cannot rot
+    merged33 = Inception(
+        64, 96, 128, 16, 32, 32, merged_1x1=True, merged_3x3=True
+    )
+    v1 = stock.init(jax.random.PRNGKey(1), x, train=False)
+    for other in (merged, merged33):
+        v2 = other.init(jax.random.PRNGKey(1), x, train=False)
+        assert jax.tree_util.tree_structure(
+            v1
+        ) == jax.tree_util.tree_structure(v2)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(v1), jax.tree_util.tree_leaves(v2)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def run(model):
+        def loss_fn(params):
+            out, mut = model.apply(
+                {"params": params, "batch_stats": v1["batch_stats"]},
+                x,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return (out.astype(jnp.float32) ** 2).sum(), mut["batch_stats"]
+
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            v1["params"]
+        )
+        return loss, stats, grads
+
+    l1, s1, g1 = run(stock)
+    e1 = stock.apply(v1, x, train=False)
+    for other in (merged, merged33):
+        l2, s2, g2 = run(other)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s1), jax.tree_util.tree_leaves(s2)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+        ):
+            a, b = np.asarray(a), np.asarray(b)
+            # conv-bias gradients are analytically ZERO here (BN subtracts
+            # the batch mean right after, so the loss is invariant to conv
+            # bias) — both sides are fp noise; scale the tolerance to the
+            # leaf's gradient magnitude so real gradients stay tightly
+            # pinned
+            atol = max(5e-4, 1e-3 * float(np.abs(b).max()))
+            np.testing.assert_allclose(a, b, atol=atol, rtol=1e-3)
+        e2 = other.apply(v1, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(e1), np.asarray(e2), atol=1e-5, rtol=1e-5
+        )
