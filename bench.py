@@ -81,6 +81,47 @@ def synthetic_batch(batch: int):
     )
 
 
+def ab_bench_model(
+    model,
+    batch: int,
+    steps: int,
+    warmup: int,
+    repeats: int,
+    compute_dtype=None,
+):
+    """Chained best-of-blocks protocol over a caller-constructed model
+    instance: donated state, one D2H metric sync per block, best block
+    wins. The SHARED harness for the structural A/B tools
+    (tools/densenet_dpn_ab.py, tools/googlenet_ab.py) so their published
+    numbers stay protocol-comparable. Returns (ms_per_step, img_per_sec).
+    """
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+    from pytorch_cifar_tpu.train.steps import make_train_step
+
+    compute_dtype = compute_dtype or jnp.bfloat16
+    tx = make_optimizer(lr=1e-3, t_max=200, steps_per_epoch=98)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    step = jax.jit(
+        make_train_step(compute_dtype=compute_dtype), donate_argnums=(0,)
+    )
+    x, y = synthetic_batch(batch)
+    rng = jax.random.PRNGKey(42)
+    m = None
+    for _ in range(warmup):
+        state, m = step(state, (x, y), rng)
+    if m is not None:
+        float(m["loss_sum"])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, (x, y), rng)
+        float(m["loss_sum"])
+        best = min(best, time.perf_counter() - t0)
+    return best / steps * 1e3, batch * steps / best
+
+
 def build_step(model_name: str, batch: int, compute_dtype):
     from pytorch_cifar_tpu import tpu_compiler_options
     from pytorch_cifar_tpu.train.steps import make_train_step

@@ -1,0 +1,244 @@
+"""Torch-checkpoint compatibility: import reference ``ckpt.pth`` weights.
+
+The reference saves ``{'net': state_dict, 'acc': ..., 'epoch': ...}``
+(main.py:140-147, main_dist.py:239-247). A user switching frameworks can
+carry those checkpoints over: :func:`import_torch_state_dict` maps a torch
+``state_dict`` (as numpy arrays — no torch dependency here; the
+``tools/import_torch_checkpoint.py`` CLI does the ``torch.load``) onto our
+flax param/stat trees for any registry model.
+
+Alignment strategy. A ``state_dict`` lists tensors in module DEFINITION
+order, while flax param nodes are discovered in CALL order — and the two
+diverge (PreActResNet applies the shortcut conv before conv1,
+reference models/preact_resnet.py:17-21). The importer therefore records
+our model's call order with a module interceptor and pairs each node with
+the FIRST unused state_dict module of the same kind and shape (stable
+order-preserving matching within each shape class). Distinct-shape
+reorderings (the shortcut case) align exactly; identical-shape leaves keep
+their relative order in every zoo model, and every pairing is
+shape-checked, so drift fails loudly. Across the zoo every state_dict
+module matches 1:1 — even the reference's dead expand conv
+(expand_ratio==1, models/efficientnet.py:60-67) round-trips, because our
+EfficientNet mirrors its construction and (discarded) execution position;
+a module that nevertheless finds no home is reported, not silently
+dropped (tests/test_compat.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Tuple
+
+import numpy as np
+
+# linears whose input is a flattened feature map need their rows permuted
+# from torch's NCHW flatten order to our NHWC one; only LeNet — every other
+# zoo model pools to 1x1 before its classifier, where the orders coincide
+LINEAR_FLATTEN: Dict[str, Dict[int, Tuple[int, int, int]]] = {
+    "LeNet": {0: (16, 5, 5)}
+}
+
+
+def stock_execution_kwargs(name: str) -> Dict[str, Any]:
+    """Model kwargs forcing the literal per-branch execution whose CALL
+    order matches torch definition order (GoogLeNet's default merged path
+    fetches its 1x1 kernels up front; the param tree is identical, so
+    weights imported against the stock twin load into the merged model)."""
+    return {"merged_1x1": False} if name == "GoogLeNet" else {}
+
+
+def record_call_order(model, x) -> Tuple[List[Tuple[str, tuple]], Any]:
+    """Init ``model`` under an interceptor recording every leaf
+    Conv/Dense/BatchNorm scope path in call order.
+
+    Returns ``(order, variables)`` where order entries are
+    ``('conv'|'linear'|'bn', path_tuple)``.
+    """
+    import jax
+    from flax import linen as nn
+
+    from pytorch_cifar_tpu.models.common import BatchNorm as OurBatchNorm
+
+    order: List[Tuple[str, tuple]] = []
+    seen = set()
+    bn_types = (nn.BatchNorm, OurBatchNorm)
+
+    def interceptor(next_fun, args, kwargs, context):
+        m = context.module
+        if context.method_name == "__call__" and isinstance(
+            m, (nn.Conv, nn.Dense) + bn_types
+        ):
+            kind = (
+                "bn"
+                if isinstance(m, bn_types)
+                else "linear" if isinstance(m, nn.Dense) else "conv"
+            )
+            path = tuple(m.path)
+            if path not in seen:
+                seen.add(path)
+                order.append((kind, path))
+        return next_fun(*args, **kwargs)
+
+    with nn.intercept_methods(interceptor):
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    return order, variables
+
+
+def _node_at(tree, path):
+    node = tree
+    for k in path:
+        if node is None or k not in node:
+            return None
+        node = node[k]
+    return node
+
+
+def normalize_state_dict(obj: Mapping) -> Tuple[Mapping, Dict[str, Any]]:
+    """Unwrap the reference's ``{'net': sd, 'acc', 'epoch'}`` envelope and
+    strip DataParallel's ``module.`` prefixes. Returns (state_dict, meta).
+    """
+    meta: Dict[str, Any] = {}
+    sd = obj
+    if "net" in obj and isinstance(obj["net"], Mapping):
+        sd = obj["net"]
+        if "acc" in obj:
+            meta["acc"] = float(obj["acc"])
+        if "epoch" in obj:
+            meta["epoch"] = int(obj["epoch"])
+    out = {}
+    for k, v in sd.items():
+        out[k[len("module.") :] if k.startswith("module.") else k] = v
+    return out, meta
+
+
+def _torch_groups(sd: Mapping[str, np.ndarray]):
+    """Group flat ``state_dict`` keys by module prefix, preserving
+    definition order; classify each group as conv/linear/bn."""
+    prefixes: List[str] = []
+    by_prefix: Dict[str, Dict[str, np.ndarray]] = {}
+    for k, v in sd.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        prefix, _, leaf = k.rpartition(".")
+        if prefix not in by_prefix:
+            by_prefix[prefix] = {}
+            prefixes.append(prefix)
+        by_prefix[prefix][leaf] = np.asarray(v)
+    groups = []
+    for p in prefixes:
+        g = by_prefix[p]
+        if "running_mean" in g:
+            kind = "bn"
+        elif "weight" in g and g["weight"].ndim == 4:
+            kind = "conv"
+        elif "weight" in g and g["weight"].ndim == 2:
+            kind = "linear"
+        else:
+            raise ValueError(
+                f"unrecognized state_dict module {p!r} with leaves "
+                f"{sorted(g)} / weight ndim "
+                f"{g.get('weight', np.empty(0)).ndim}"
+            )
+        groups.append((kind, p, g))
+    return groups
+
+
+def _torch_signature(kind: str, g: Mapping[str, np.ndarray]):
+    if kind == "conv":
+        o, i, kh, kw = g["weight"].shape
+        return ("conv", (kh, kw, i, o), "bias" in g)
+    if kind == "linear":
+        o, i = g["weight"].shape
+        return ("linear", (i, o), "bias" in g)
+    return ("bn", g["weight"].shape, True)
+
+
+def _flax_signature(kind: str, p_node):
+    if kind == "conv":
+        return ("conv", tuple(p_node["kernel"].shape), "bias" in p_node)
+    if kind == "linear":
+        return ("linear", tuple(p_node["kernel"].shape), "bias" in p_node)
+    return ("bn", tuple(p_node["scale"].shape), True)
+
+
+def import_torch_state_dict(
+    name: str,
+    state_dict: Mapping[str, np.ndarray],
+    num_classes: int = 10,
+):
+    """Map a reference torch ``state_dict`` onto our ``name`` registry
+    model. Returns ``(params, batch_stats, report)``; ``report`` lists the
+    unmatched (dead) torch modules, if any. Raises if any of OUR nodes
+    finds no matching tensor — that means a wrong --model choice, and a
+    silently partial import would be worse than an error.
+    """
+    from pytorch_cifar_tpu.models import create_model
+
+    import jax
+
+    model = create_model(
+        name, num_classes=num_classes, **stock_execution_kwargs(name)
+    )
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    order, variables = record_call_order(model, x)
+    params = jax.tree_util.tree_map(np.asarray, dict(variables["params"]))
+    stats = jax.tree_util.tree_map(
+        np.asarray, dict(variables.get("batch_stats", {}))
+    )
+    groups = _torch_groups(state_dict)
+    used = [False] * len(groups)
+    linear_i = 0
+    flatten = LINEAR_FLATTEN.get(name, {})
+
+    for kind, path in order:
+        p_node = _node_at(params, path)
+        if p_node is None:
+            raise ValueError(f"no param node at {path} for recorded {kind}")
+        sig = _flax_signature(kind, p_node)
+        for gi, (tk, tprefix, g) in enumerate(groups):
+            if used[gi] or tk != kind:
+                continue
+            if _torch_signature(tk, g) != sig:
+                continue
+            used[gi] = True
+            if kind == "conv":
+                p_node["kernel"] = np.transpose(g["weight"], (2, 3, 1, 0))
+                if "bias" in g:
+                    p_node["bias"] = g["bias"]
+            elif kind == "linear":
+                w = g["weight"]
+                if linear_i in flatten:
+                    c, h, wd = flatten[linear_i]
+                    w = (
+                        w.reshape(-1, c, h, wd)
+                        .transpose(0, 2, 3, 1)
+                        .reshape(w.shape[0], -1)
+                    )
+                p_node["kernel"] = w.T
+                if "bias" in g:
+                    p_node["bias"] = g["bias"]
+            else:
+                p_node["scale"] = g["weight"]
+                p_node["bias"] = g["bias"]
+                s_node = _node_at(stats, path)
+                if s_node is None:
+                    raise ValueError(f"no batch_stats node at {path}")
+                s_node["mean"] = g["running_mean"]
+                s_node["var"] = g["running_var"]
+            break
+        else:
+            raise ValueError(
+                f"state_dict has no unused {kind} of signature {sig} for "
+                f"our node {'/'.join(path)} — wrong --model for this "
+                "checkpoint?"
+            )
+        if kind == "linear":
+            linear_i += 1
+
+    report = {
+        "unmatched_torch_modules": [
+            f"{tprefix} ({tk})"
+            for (tk, tprefix, _), u in zip(groups, used)
+            if not u
+        ]
+    }
+    return params, stats, report

@@ -103,3 +103,19 @@ def test_bn_bench_smoke():
         [os.path.join(REPO, "tools", "bn_bench.py")], timeout=560
     )
     assert "fused" in out.stdout.lower() or "moments" in out.stdout.lower()
+
+
+def test_googlenet_ab_smoke():
+    """googlenet_ab: all three arms (stock / merged / merged+3x3) run
+    through the shared chained harness and print a line each."""
+    out = _run_tool(
+        [
+            os.path.join(REPO, "tools", "googlenet_ab.py"),
+            "--batch", "16", "--steps", "2", "--warmup", "1",
+        ],
+        timeout=560,
+    )
+    lines = [l for l in out.stdout.splitlines() if "img/s" in l]
+    assert len(lines) == 3, out.stdout
+    assert any("stock" in l for l in lines)
+    assert any("merged_1x1 " in l or "merged_1x1:" in l for l in lines)

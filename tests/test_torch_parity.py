@@ -184,42 +184,13 @@ def _stats_at(stats, path):
     return node
 
 
-def record_flax_call_order(model, x):
-    """Init the model under an interceptor that records the scope path of
-    every leaf Conv/Dense/BatchNorm call, in call order.
-
-    flax param dicts iterate in sorted-key order, not creation order, so the
-    pairing order against torch's definition-order modules has to come from
-    the trace itself.
-    """
-    import jax
-    from flax import linen as nn
-
-    from pytorch_cifar_tpu.models.common import BatchNorm as OurBatchNorm
-
-    order = []
-    seen = set()
-    bn_types = (nn.BatchNorm, OurBatchNorm)
-
-    def interceptor(next_fun, args, kwargs, context):
-        m = context.module
-        if context.method_name == "__call__" and isinstance(
-            m, (nn.Conv, nn.Dense) + bn_types
-        ):
-            kind = (
-                "bn"
-                if isinstance(m, bn_types)
-                else "linear" if isinstance(m, nn.Dense) else "conv"
-            )
-            path = tuple(m.path)
-            if path not in seen:
-                seen.add(path)
-                order.append((kind, path))
-        return next_fun(*args, **kwargs)
-
-    with nn.intercept_methods(interceptor):
-        variables = model.init(jax.random.PRNGKey(0), x, train=False)
-    return order, variables
+# the interceptor-based call-order recorder lives in the package now (the
+# user-facing checkpoint importer relies on it); these tests exercising the
+# SAME function is what makes them evidence for compat's alignment contract
+from pytorch_cifar_tpu.compat import (  # noqa: E402
+    record_call_order as record_flax_call_order,
+    stock_execution_kwargs,
+)
 
 
 def flax_leaf_ops(params, stats, call_order):
@@ -301,9 +272,7 @@ def test_forward_parity(name, ref_expr):
     # bit-identical (asserted in test_models.py) — then apply the
     # transplanted weights through the DEFAULT merged model, which makes
     # this parity test cover the merged path's numerics too.
-    record_model = (
-        create_model(name, merged_1x1=False) if name == "GoogLeNet" else model
-    )
+    record_model = create_model(name, **stock_execution_kwargs(name))
     call_order, variables = record_flax_call_order(record_model, x_nhwc[:2])
     params = jax.tree_util.tree_map(np.asarray, dict(variables["params"]))
     stats = jax.tree_util.tree_map(

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -27,14 +26,9 @@ def main() -> int:
     honor_platform_env()
     enable_compilation_cache()
 
-    import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from pytorch_cifar_tpu.models.googlenet import GoogLeNet
-    from pytorch_cifar_tpu.train.optim import make_optimizer
-    from pytorch_cifar_tpu.train.state import create_train_state
-    from pytorch_cifar_tpu.train.steps import make_train_step
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--batch", type=int, default=512)
@@ -43,38 +37,14 @@ def main() -> int:
     parser.add_argument("--repeats", type=int, default=2)
     args = parser.parse_args()
 
-    from bench import clamp_for_cpu
+    from bench import ab_bench_model, clamp_for_cpu
 
     clamp_for_cpu(args)
 
     def bench_model(model):
-        tx = make_optimizer(lr=1e-3, t_max=200, steps_per_epoch=98)
-        state = create_train_state(model, jax.random.PRNGKey(0), tx)
-        step = jax.jit(
-            make_train_step(compute_dtype=jnp.bfloat16), donate_argnums=(0,)
+        return ab_bench_model(
+            model, args.batch, args.steps, args.warmup, args.repeats
         )
-        rs = np.random.RandomState(0)
-        x = jax.device_put(
-            rs.randint(0, 256, size=(args.batch, 32, 32, 3), dtype=np.uint8)
-        )
-        y = jax.device_put(
-            rs.randint(0, 10, size=(args.batch,)).astype(np.int32)
-        )
-        rng = jax.random.PRNGKey(42)
-        m = None
-        for _ in range(args.warmup):
-            state, m = step(state, (x, y), rng)
-        if m is not None:
-            float(m["loss_sum"])
-        best = float("inf")
-        for _ in range(args.repeats):
-            t0 = time.perf_counter()
-            for _ in range(args.steps):
-                state, m = step(state, (x, y), rng)
-            float(m["loss_sum"])
-            best = min(best, time.perf_counter() - t0)
-        ms = best / args.steps * 1e3
-        return ms, args.batch * args.steps / best
 
     for name, m1, m3 in (
         ("GoogLeNet stock          ", False, False),

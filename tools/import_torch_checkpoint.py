@@ -1,0 +1,116 @@
+"""Import a reference ``ckpt.pth`` into this framework's checkpoint format.
+
+The reference checkpoints ``{'net': state_dict, 'acc': best_acc,
+'epoch': N}`` (main.py:140-147). This tool loads one (torch CPU), maps the
+weights onto the chosen registry model (``pytorch_cifar_tpu.compat``), and
+writes our ``ckpt.msgpack`` + JSON sidecar so ``train.py --resume`` (or
+``--eval_only``) continues from it. Optimizer momentum starts fresh —
+exactly the reference's own resume semantics, which restore only
+net/acc/epoch (main.py:116-123).
+
+Usage:
+    python tools/import_torch_checkpoint.py \
+        --pth /path/to/checkpoint/ckpt.pth --model ResNet18 --out ./checkpoint
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import honor_platform_env
+
+    honor_platform_env()
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--pth", required=True, help="torch checkpoint path")
+    parser.add_argument("--model", required=True, help="registry model name")
+    parser.add_argument("--out", required=True, help="output checkpoint dir")
+    parser.add_argument("--num_classes", type=int, default=10)
+    parser.add_argument(
+        "--lr", type=float, default=0.1,
+        help="LR used to build the (fresh) optimizer state in the "
+        "checkpoint; match your planned --resume run",
+    )
+    parser.add_argument(
+        "--allow-unmatched", action="store_true",
+        help="proceed even if some state_dict modules found no home; "
+        "across the reference zoo every module (even EfficientNet's dead "
+        "expand conv) matches 1:1, so leftovers usually mean the wrong "
+        "--model for this checkpoint",
+    )
+    args = parser.parse_args()
+
+    try:
+        import torch
+    except ImportError:
+        print("torch is required to read .pth files", file=sys.stderr)
+        return 2
+
+    import numpy as np
+
+    import jax
+
+    from pytorch_cifar_tpu.compat import (
+        import_torch_state_dict,
+        normalize_state_dict,
+    )
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    obj = torch.load(args.pth, map_location="cpu")
+    sd, meta = normalize_state_dict(
+        {
+            k: (v.detach().cpu().numpy() if torch.is_tensor(v) else v)
+            for k, v in (
+                obj.items() if isinstance(obj, dict) else obj.state_dict().items()
+            )
+        }
+    )
+    params, stats, report = import_torch_state_dict(
+        args.model, sd, num_classes=args.num_classes
+    )
+    if report["unmatched_torch_modules"]:
+        msg = (
+            f"{len(report['unmatched_torch_modules'])} state_dict modules "
+            "found no matching node: "
+            + ", ".join(report["unmatched_torch_modules"])
+        )
+        if not args.allow_unmatched:
+            print(
+                "error: " + msg + "\nEvery reference-zoo checkpoint module "
+                "matches 1:1 against its registry model, so leftovers "
+                "usually mean a wrong --model (a shape-compatible but "
+                "different architecture can partially first-fit-match!). "
+                "Re-run with --allow-unmatched to accept.",
+                file=sys.stderr,
+            )
+            return 3
+        print("warning: " + msg)
+
+    model = create_model(args.model, num_classes=args.num_classes)
+    tx = make_optimizer(lr=args.lr, t_max=200, steps_per_epoch=98)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    state = state.replace(
+        params=jax.tree_util.tree_map(np.asarray, params),
+        batch_stats=jax.tree_util.tree_map(np.asarray, stats),
+    )
+    epoch = meta.get("epoch", -1)
+    acc = meta.get("acc", 0.0)
+    path = save_checkpoint(args.out, state, epoch=epoch, best_acc=acc)
+    print(
+        f"imported {args.pth} -> {path} (model {args.model}, "
+        f"epoch {epoch}, best_acc {acc:.2f})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
