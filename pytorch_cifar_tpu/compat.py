@@ -170,6 +170,15 @@ def import_torch_state_dict(
     unmatched (dead) torch modules, if any. Raises if any of OUR nodes
     finds no matching tensor — that means a wrong --model choice, and a
     silently partial import would be worse than an error.
+
+    SCOPE: the first-fit-within-shape-class alignment is verified for the
+    reference zoo only (every zoo model keeps identical-shape leaves in the
+    same relative order on both sides — pinned by the transplant parity
+    suite, tests/test_torch_parity.py). For a model OUTSIDE the zoo, two
+    same-kind same-shape modules called in a different order than torch
+    defines them would cross-pair silently: the import stays shape-valid
+    but loads the wrong tensors. Validate non-zoo imports with a forward
+    cross-check against the donor model's outputs.
     """
     from pytorch_cifar_tpu.models import create_model
 
@@ -229,7 +238,8 @@ def import_torch_state_dict(
             raise ValueError(
                 f"state_dict has no unused {kind} of signature {sig} for "
                 f"our node {'/'.join(path)} — wrong --model for this "
-                "checkpoint?"
+                "checkpoint? (Alignment is only guaranteed for the "
+                "reference zoo; see import_torch_state_dict's SCOPE note.)"
             )
         if kind == "linear":
             linear_i += 1

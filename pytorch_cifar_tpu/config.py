@@ -65,6 +65,15 @@ class TrainConfig:
     # ~2.8 ms. Auto-gated to TPU meshes; --no-dma_gather forces the XLA
     # gather (e.g. if a future Mosaic regression bites).
     dma_gather: bool = True
+    # generate each epoch's shuffle permutation ON DEVICE (seeded from
+    # (seed, epoch) via jax.random) instead of uploading a host-numpy one:
+    # the device data plane's per-epoch H2D drops to literally zero (the
+    # ~200 KB permutation upload shared the serialized transport with
+    # metric fetches — the last host dependency in the hot loop,
+    # BENCHMARKS.md round 3 "remaining delta"). The shuffle stream differs
+    # from the host generator's (both are (seed, epoch)-deterministic
+    # uniform permutations); --no-device_perm restores the host stream.
+    device_perm: bool = True
     mean: Tuple[float, float, float] = (0.4914, 0.4822, 0.4465)  # main.py:34
     std: Tuple[float, float, float] = (0.2023, 0.1994, 0.2010)
 

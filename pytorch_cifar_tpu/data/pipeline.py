@@ -280,6 +280,7 @@ class DeviceDataset:
         seed: int = 0,
         sharding: Optional[jax.sharding.Sharding] = None,
         label_sharding: Optional[jax.sharding.Sharding] = None,
+        device_perm: bool = False,
     ):
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
@@ -323,6 +324,37 @@ class DeviceDataset:
             materialize,
             **({"out_shardings": out_sh} if out_sh is not None else {}),
         )
+        # device_perm: generate the epoch permutation ON DEVICE from
+        # (seed, epoch) — a Fisher-Yates-equivalent jax.random.permutation
+        # inside one tiny jitted dispatch — instead of uploading a
+        # host-numpy permutation. Removes the last per-epoch H2D transfer
+        # of the device data plane (only the 4-byte epoch scalar rides the
+        # dispatch). The permutation DIFFERS from the host RandomState one
+        # (different generator), so the host/device bit-exactness pin
+        # (tests/test_data.py) uses device_perm=False; the device stream is
+        # pinned at the distribution level instead (valid permutation,
+        # (seed, epoch)-deterministic, epoch-distinct, topology-invariant).
+        self.device_perm = device_perm and shuffle
+        if self.device_perm:
+            base_key = jax.random.PRNGKey(seed)
+            total = len(self) * batch_size
+            n_data = self.n
+
+            def device_epoch_perm(epoch):
+                key = jax.random.fold_in(base_key, epoch)
+                order = jax.random.permutation(key, n_data)
+                if total <= n_data:
+                    ext = order[:total]
+                else:
+                    j = jnp.arange(total, dtype=jnp.int32)
+                    ext = order[j % n_data]
+                return ext.astype(jnp.int32)
+
+            rep = self._replicated
+            self._device_perm_fn = jax.jit(
+                device_epoch_perm,
+                **({"out_shardings": rep} if rep is not None else {}),
+            )
         if not shuffle:
             self._perm_static = self._put_perm(self._epoch_perm(order=None))
 
@@ -365,10 +397,16 @@ class DeviceDataset:
 
     def staged_perm(self, epoch: int) -> jax.Array:
         """The epoch's extended permutation, staged on device (replicated).
-        The only per-epoch H2D transfer of the device data plane (~200 KB);
-        shuffle=False reuses one staged identity permutation forever."""
+
+        ``device_perm=True`` (the production default via config.device_perm)
+        computes it on device — zero per-epoch H2D; otherwise the host
+        permutation is uploaded (~200 KB — the only per-epoch transfer of
+        the device data plane). shuffle=False reuses one staged identity
+        permutation forever."""
         if not self.shuffle:
             return self._perm_static
+        if self.device_perm:
+            return self._device_perm_fn(np.int32(epoch))
         order = np.random.RandomState(
             (self.seed * 100003 + epoch) % (2**31)
         ).permutation(self.n)

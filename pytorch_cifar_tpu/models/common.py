@@ -368,7 +368,9 @@ def bn_batch_moments(x):
     impl = _BN_MOMENTS_IMPL.get()
     if impl is not None:
         return impl(x)
-    xf = x.astype(jnp.float32)
+    # at-least-fp32: bf16 inputs accumulate in fp32; f64 inputs (the x64
+    # trajectory-parity harness, tests/test_torch_parity.py) stay f64
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     axes = tuple(range(x.ndim - 1))
     return jnp.mean(xf, axis=axes), jnp.mean(jnp.square(xf), axis=axes)
 
@@ -439,7 +441,7 @@ class BatchNorm(nn.Module):
             elif not self.is_initializing():
                 mean, sq = bn_batch_moments(x)
             else:
-                xf = x.astype(jnp.float32)
+                xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
                 mean = jnp.mean(xf, axis=axes)
                 sq = jnp.mean(jnp.square(xf), axis=axes)
             world = 1

@@ -68,6 +68,13 @@ class Inception(nn.Module):
             )(h)
             return nn.relu(h)
 
+        if self.merged_3x3 and not self.merged_1x1:
+            # merged_3x3 consumes the split points the merged-heads path
+            # produces; silently running stock here would ignore the flag
+            raise ValueError(
+                "merged_3x3=True requires merged_1x1=True (the mid-level "
+                "merge operates on the merged heads' outputs)"
+            )
         # explicit names == the stock path's auto-assigned ones, so both
         # modes build the same param tree; the stock path keeps the full
         # per-branch CALL order (y1, y2, y3, y4 — torch definition order,

@@ -68,6 +68,23 @@ def save_checkpoint(
     return path
 
 
+def remove_stale_last(output_dir: str) -> None:
+    """Delete the preemption save (last.msgpack + sidecar) after a run
+    COMPLETES normally: a leftover one would make a routine relaunch with
+    --resume roll training back to the preemption point. Shared by
+    Trainer.fit and tools/accuracy_run.py so the rule cannot drift."""
+    if jax.process_index() != 0 or not output_dir:
+        return
+    for path in (
+        os.path.join(output_dir, LAST_NAME),
+        meta_path(output_dir, LAST_NAME),
+    ):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
 def restore_checkpoint(
     output_dir: str, state: TrainState, name: str = CKPT_NAME
 ) -> Tuple[TrainState, int, float]:

@@ -38,7 +38,10 @@ def cross_entropy_sums(logits: jax.Array, labels: jax.Array):
     all reduce these same two numbers."""
     valid = labels >= 0
     losses = optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), jnp.maximum(labels, 0)
+        # at-least-fp32: bf16 logits promote to fp32; f64 logits (the x64
+        # trajectory-parity harness) are not demoted
+        logits.astype(jnp.promote_types(logits.dtype, jnp.float32)),
+        jnp.maximum(labels, 0),
     )
     return jnp.where(valid, losses, 0.0).sum(), valid.sum()
 

@@ -51,6 +51,7 @@ from pytorch_cifar_tpu.train.checkpoint import (
     CKPT_NAME,
     LAST_NAME,
     meta_path,
+    remove_stale_last,
     restore_checkpoint,
     save_checkpoint,
 )
@@ -195,6 +196,7 @@ class Trainer:
                 seed=config.seed,
                 sharding=sharding,
                 label_sharding=lbl_sharding,
+                device_perm=config.device_perm,
             )
             self.steps_per_epoch = len(self.loader)
         else:
@@ -811,15 +813,7 @@ class Trainer:
                 # completed normally: a leftover preemption save is now
                 # stale; remove it so a routine relaunch with --resume
                 # cannot roll training back (process-0 writes only)
-                if is_primary() and cfg.output_dir:
-                    for path in (
-                        os.path.join(cfg.output_dir, LAST_NAME),
-                        meta_path(cfg.output_dir, LAST_NAME),
-                    ):
-                        try:
-                            os.remove(path)
-                        except OSError:
-                            pass
+                remove_stale_last(cfg.output_dir)
         finally:
             # A crash mid-epoch must not lose the PREVIOUS epoch's
             # completed eval + best-checkpoint gate (its results are

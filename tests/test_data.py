@@ -175,6 +175,56 @@ def test_device_dataset_matches_host_loader_bitexact():
             assert dx.sharding.is_equivalent_to(hx.sharding, dx.ndim)
 
 
+def test_device_perm_stream():
+    """device_perm=True (the production default via config.device_perm):
+    the permutation is generated ON DEVICE — zero per-epoch H2D — from
+    (seed, epoch). Different generator than the host stream, same
+    contract: a valid uniform permutation, deterministic in (seed, epoch),
+    distinct across epochs, wrap-padded by the same rule, batches masked
+    identically."""
+    from pytorch_cifar_tpu.data.pipeline import DeviceDataset
+    from pytorch_cifar_tpu.parallel import batch_sharding, make_mesh
+
+    n, bs = 70, 16
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 256, (n, 32, 32, 3), np.uint8)
+    y = rs.randint(0, 10, (n,)).astype(np.int32)
+    sh = batch_sharding(make_mesh())
+    dev = DeviceDataset(
+        x, y, batch_size=bs, drop_last=False, seed=9, sharding=sh,
+        device_perm=True,
+    )
+    nb = len(dev)
+    p0 = np.asarray(dev.staged_perm(0))
+    p1 = np.asarray(dev.staged_perm(1))
+    assert p0.shape == (nb * bs,)
+    np.testing.assert_array_equal(np.sort(p0[:n]), np.arange(n))  # valid perm
+    np.testing.assert_array_equal(p0[n:], p0[: nb * bs - n])  # wrap rule
+    np.testing.assert_array_equal(np.sort(p1[:n]), np.arange(n))
+    assert (p0[:n] != p1[:n]).any()  # epoch-distinct
+    # deterministic: same call and a fresh same-seed dataset both reproduce
+    np.testing.assert_array_equal(np.asarray(dev.staged_perm(0)), p0)
+    dev2 = DeviceDataset(
+        x, y, batch_size=bs, drop_last=False, seed=9, sharding=sh,
+        device_perm=True,
+    )
+    np.testing.assert_array_equal(np.asarray(dev2.staged_perm(0)), p0)
+    # a different seed gives a different stream
+    dev3 = DeviceDataset(
+        x, y, batch_size=bs, drop_last=False, seed=10, sharding=sh,
+        device_perm=True,
+    )
+    assert (np.asarray(dev3.staged_perm(0))[:n] != p0[:n]).any()
+    # batches materialize against this perm with the host masking contract
+    xs, ys = zip(*[(np.asarray(bx), np.asarray(by)) for bx, by in dev.epoch(0)])
+    xs, ys = np.concatenate(xs), np.concatenate(ys)
+    valid = ys >= 0
+    assert valid.sum() == n  # every image exactly once
+    np.testing.assert_array_equal(np.where(~valid)[0], np.arange(n, nb * bs))
+    np.testing.assert_array_equal(xs, x[p0])
+    np.testing.assert_array_equal(ys[valid], y[p0[:n]])
+
+
 def test_device_dataset_eval_mode_identity_order():
     """shuffle=False: rows come back in order, every row exactly once,
     ragged tail masked with -1 (the eval_batches contract) with zero

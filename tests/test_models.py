@@ -220,6 +220,18 @@ def test_densenet_shared_stats_matches_stock():
     np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
 
 
+def test_googlenet_merged_3x3_requires_merged_1x1():
+    """merged_3x3 operates on the merged heads' outputs; without
+    merged_1x1 it used to be silently ignored (ADVICE round 3) — now it
+    raises."""
+    from pytorch_cifar_tpu.models.googlenet import Inception
+
+    x = jnp.zeros((2, 8, 8, 64))
+    bad = Inception(64, 96, 128, 16, 32, 32, merged_1x1=False, merged_3x3=True)
+    with pytest.raises(ValueError, match="merged_1x1"):
+        bad.init(jax.random.PRNGKey(0), x, train=False)
+
+
 def test_googlenet_merged_1x1_matches_stock():
     """GoogLeNet's merged-branch path (the cell's three same-input 1x1
     convs executed as one wider conv + one BN-moments reduce) must match

@@ -83,9 +83,13 @@ def test_epoch_compiled_matches_step_loop(tmp_path):
     same permutation, same augmentation stream (keys fold state.step +
     axis_index identically), same wrap-pad masking — so the two paths are
     interchangeable and the dispatch optimization can never change a
-    trajectory. Ragged batch included (512 % 96 != 0)."""
+    trajectory. Ragged batch included (512 % 96 != 0). device_perm=False:
+    this pin compares against the HOST loader, which only exists for the
+    host permutation stream (the on-device stream is a different —
+    equally uniform — generator, pinned in test_data.py)."""
     cfg_dev = small_config(
-        tmp_path / "dev", epochs=1, batch_size=96, device_data=True
+        tmp_path / "dev", epochs=1, batch_size=96, device_data=True,
+        device_perm=False,
     )
     cfg_host = small_config(
         tmp_path / "host", epochs=1, batch_size=96, device_data=False

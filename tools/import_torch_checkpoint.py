@@ -44,6 +44,12 @@ def main() -> int:
         "expand conv) matches 1:1, so leftovers usually mean the wrong "
         "--model for this checkpoint",
     )
+    parser.add_argument(
+        "--unsafe-load", action="store_true",
+        help="permit the unrestricted torch.load fallback for full-model "
+        "pickles. OFF by default: unrestricted unpickling EXECUTES "
+        "arbitrary code from the file — only use on checkpoints you trust",
+    )
     args = parser.parse_args()
 
     try:
@@ -65,13 +71,51 @@ def main() -> int:
     from pytorch_cifar_tpu.train.optim import make_optimizer
     from pytorch_cifar_tpu.train.state import create_train_state
 
-    obj = torch.load(args.pth, map_location="cpu")
+    import os
+    import pickle
+
+    if not os.path.isfile(args.pth):
+        print(f"error: no such file: {args.pth}", file=sys.stderr)
+        return 2
+    # weights_only first: the reference envelope (tensors + floats + ints,
+    # main.py:140-147) loads fine under it, and it refuses the arbitrary
+    # pickle code execution an untrusted full-model .pth could carry.
+    # Only unpickling errors route to the fallback decision — a missing or
+    # corrupt file must not be misreported as a full-model pickle.
+    try:
+        obj = torch.load(args.pth, map_location="cpu", weights_only=True)
+    except (pickle.UnpicklingError, RuntimeError) as e:
+        if not args.unsafe_load:
+            print(
+                f"error: safe (weights_only) load failed: {e}\nIf this is "
+                "a trusted full-model pickle, re-run with --unsafe-load "
+                "(unrestricted unpickling executes code from the file).",
+                file=sys.stderr,
+            )
+            return 2
+        print(
+            "warning: weights_only load failed; --unsafe-load given, "
+            "falling back to unrestricted torch.load",
+            file=sys.stderr,
+        )
+        obj = torch.load(args.pth, map_location="cpu", weights_only=False)
+    if isinstance(obj, dict):
+        items = obj.items()
+    elif hasattr(obj, "state_dict"):
+        items = obj.state_dict().items()
+    else:
+        print(
+            f"error: {args.pth} holds a {type(obj).__name__}, not a "
+            "checkpoint dict or a module with .state_dict() — expected the "
+            "reference's {'net': state_dict, 'acc', 'epoch'} envelope "
+            "(main.py:140-147) or a bare state_dict",
+            file=sys.stderr,
+        )
+        return 2
     sd, meta = normalize_state_dict(
         {
             k: (v.detach().cpu().numpy() if torch.is_tensor(v) else v)
-            for k, v in (
-                obj.items() if isinstance(obj, dict) else obj.state_dict().items()
-            )
+            for k, v in items
         }
     )
     params, stats, report = import_torch_state_dict(
