@@ -130,7 +130,7 @@ def build_step(model_name: str, batch: int, compute_dtype):
     step = jax.jit(
         make_train_step(compute_dtype=compute_dtype),
         donate_argnums=(0,),
-        compiler_options=tpu_compiler_options(),
+        compiler_options=tpu_compiler_options(model=model_name),
     )
     return state, step
 
@@ -160,7 +160,7 @@ def run_eval(
     state = build_state(model, batch, compute_dtype)
     step = jax.jit(
         make_eval_step(compute_dtype=compute_dtype),
-        compiler_options=tpu_compiler_options(),
+        compiler_options=tpu_compiler_options(model=model),
     )
     x, y = synthetic_batch(batch)
     metrics = None

@@ -72,13 +72,27 @@ def honor_platform_env() -> None:
             jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 
-def tpu_compiler_options(device=None):
+# Measured per-model scoped-VMEM budgets (tools/vmem_ab.py, interleaved
+# A/B on the v5e — BENCHMARKS.md round 4). Raising the budget from the
+# compiler's 16 MB default to 32 MB buys deeper fusion tiles, which is
+# NOT globally good: +3% on ResNet18 but -25% on GoogLeNet (big fused
+# tiles hurt its pool/concat-heavy cells), neutral-to-negative on the
+# other measured families. Only measured winners are listed; unmeasured
+# models get the compiler default.
+_VMEM_BUDGET_KIB = {
+    "ResNet18": "32768",  # 33.5k -> 34.4k img/s (+3%)
+}
+
+
+def tpu_compiler_options(device=None, model: str = None):
     """Per-compile XLA options for the jitted steps; None off-TPU.
 
-    ``xla_tpu_scoped_vmem_limit_kib=32768`` doubles the compiler's scoped
-    VMEM budget (v5e has 128 MB physical; the default budget is 16 MB),
-    buying deeper fusion tiles. Interleaved A/B on the v5e: ResNet18 b512
-    train step 33.9k -> 35.0k img/s (+3%), no regression at 64 MB.
+    ``model``: registry name of the model the step compiles — consulted
+    against the measured per-model scoped-VMEM table above (the
+    cudnn.benchmark analogue: the reference autotunes per-shape at
+    runtime, main.py:75; here the tuning is measured offline with
+    tools/vmem_ab.py and checked in). Callers that don't know the model
+    (or an unmeasured model) get the compiler default.
 
     ``device``: the device the jit will actually target (e.g.
     ``mesh.devices.flat[0]``) — the default backend can be a different
@@ -89,9 +103,12 @@ def tpu_compiler_options(device=None):
 
     if device is None:
         device = jax.devices()[0]
-    if device.platform == "tpu":
-        return {"xla_tpu_scoped_vmem_limit_kib": "32768"}
-    return None
+    if device.platform != "tpu":
+        return None
+    budget = _VMEM_BUDGET_KIB.get(model)
+    return (
+        {"xla_tpu_scoped_vmem_limit_kib": budget} if budget else None
+    )
 
 
 def enable_compilation_cache(path: str = None) -> None:

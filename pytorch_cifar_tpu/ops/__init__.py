@@ -12,5 +12,15 @@ from pytorch_cifar_tpu.ops.conv_bn_relu import (
     conv3x3_bn_relu_reference,
     fold_batchnorm,
 )
+from pytorch_cifar_tpu.ops.depthwise_stencil import (
+    depthwise_stencil,
+    depthwise_xla,
+)
 
-__all__ = ["conv3x3_bn_relu", "conv3x3_bn_relu_reference", "fold_batchnorm"]
+__all__ = [
+    "conv3x3_bn_relu",
+    "conv3x3_bn_relu_reference",
+    "fold_batchnorm",
+    "depthwise_stencil",
+    "depthwise_xla",
+]

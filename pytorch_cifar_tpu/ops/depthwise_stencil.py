@@ -1,0 +1,102 @@
+"""Pallas TPU kernel: depthwise KxK / stride-1 / SAME conv as a VMEM stencil.
+
+The round-4 experiment closing the depthwise pool named in round 3's
+closure (BENCHMARKS.md "PNASNet ... remaining headroom pools (a)"): the
+zoo's depthwise-heavy families (PNASNet's 7x7/5x5 SepConvs — reference
+models/pnasnet.py:10-22 — and MobileNet's 3x3s, models/mobilenet.py:15)
+are VPU-bound, and XLA's native grouped-conv lowering measured 2.12 ms
+fwd at (512,32,32,44) k=7 bf16 with an HBM-bytes roofline of ~0.6 ms.
+
+Design: one program holds an (nb, H, W, cb) tile in VMEM, zero-pads it
+VMEM-locally (no HBM pre-pad — the max_pool round-2 lesson), and
+accumulates the K*K shifted multiply-adds in f32 registers. Channels ride
+the 128-lane axis; W rides sublanes, so each dx!=0 tap is a
+sublane-misaligned read — the SAME Mosaic constraint isolated for the
+max-pool kernel (load+load+funnel-shift per vreg, BENCHMARKS.md round 3).
+The measured outcome and the ceiling analysis live in BENCHMARKS.md round
+4 (tools/depthwise_bench.py is the A/B harness).
+
+Status: NOT wired into the zoo — kept as the experiment's artifact with
+exactness pinned in tests/test_ops.py (interpret mode). See BENCHMARKS.md
+round 4 for the measured verdict.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_cifar_tpu.ops.blocking import batch_chunk, channel_chunk, pad_channels
+
+
+def _kernel(x_ref, w_ref, o_ref, *, h, w, k):
+    p = k // 2
+    x = x_ref[...].astype(jnp.float32)  # (nb, h, w, cb)
+    xp = jnp.pad(x, [(0, 0), (p, p), (p, p), (0, 0)])  # VMEM-local halo
+    wv = w_ref[...].astype(jnp.float32)  # (k, k, cb)
+    acc = None
+    for dy in range(k):
+        for dx in range(k):
+            t = xp[:, dy : dy + h, dx : dx + w, :] * wv[dy, dx, :]
+            acc = t if acc is None else acc + t
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+def _spec(shape):
+    return pl.BlockSpec(
+        shape, lambda i, j: (i, 0, 0, j), memory_space=pltpu.VMEM
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "max_nb")
+)
+def depthwise_stencil(x, w, interpret: bool = False, max_nb: int = 4):
+    """Depthwise conv, NHWC x: (N,H,W,C), w: (K,K,C), stride 1, SAME.
+
+    Forward only — this is a measurement artifact, not a wired op; the
+    A/B against ``lax.conv_general_dilated(feature_group_count=C)`` runs
+    in tools/depthwise_bench.py.
+    """
+    n, h, wd, c = x.shape
+    k = w.shape[0]
+    assert w.shape == (k, k, c), (w.shape, c)
+    cb = channel_chunk(c)
+    x, c0 = pad_channels(x, cb)
+    w, _ = pad_channels(w, cb)
+    cp = x.shape[-1]
+    nb = batch_chunk(n, max_nb=max_nb)
+    kernel = functools.partial(_kernel, h=h, w=wd, k=k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n // nb, cp // cb),
+        in_specs=[
+            _spec((nb, h, wd, cb)),
+            pl.BlockSpec(
+                (k, k, cb), lambda i, j: (0, 0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=_spec((nb, h, wd, cb)),
+        out_shape=jax.ShapeDtypeStruct((n, h, wd, cp), x.dtype),
+        interpret=interpret,
+    )(x, w)
+    return out[..., :c0]
+
+
+def depthwise_xla(x, w):
+    """The native lowering this kernel is racing: grouped conv with
+    feature_group_count == C (what flax emits for our depthwise layers)."""
+    c = x.shape[-1]
+    k = w.shape[0]
+    return jax.lax.conv_general_dilated(
+        x,
+        w.reshape(k, k, 1, c),
+        window_strides=(1, 1),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+    )

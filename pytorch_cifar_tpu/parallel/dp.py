@@ -49,7 +49,11 @@ def unreplicate(tree):
 
 
 def data_parallel_train_step(
-    step_fn: Callable, mesh: Mesh, axis: str = DATA_AXIS, donate: bool = True
+    step_fn: Callable,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    donate: bool = True,
+    model_name: Optional[str] = None,
 ) -> Callable:
     """Wrap a per-shard train step (built with ``make_train_step(
     axis_name=axis)``) into a jitted SPMD step over ``mesh``.
@@ -69,12 +73,15 @@ def data_parallel_train_step(
     return jax.jit(
         mapped,
         donate_argnums=(0,) if donate else (),
-        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 
 
 def data_parallel_eval_step(
-    step_fn: Callable, mesh: Mesh, axis: str = DATA_AXIS
+    step_fn: Callable,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+    model_name: Optional[str] = None,
 ) -> Callable:
     """Wrap a per-shard eval step (``make_eval_step(axis_name=axis)``)."""
     from pytorch_cifar_tpu import tpu_compiler_options
@@ -86,11 +93,14 @@ def data_parallel_eval_step(
         out_specs=P(),
         check_vma=False,
     )
-    return jax.jit(mapped, compiler_options=tpu_compiler_options(mesh.devices.flat[0]))
+    return jax.jit(mapped, compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name))
 
 
 def data_parallel_train_epoch(
-    epoch_fn: Callable, mesh: Mesh, donate: bool = True
+    epoch_fn: Callable,
+    mesh: Mesh,
+    donate: bool = True,
+    model_name: Optional[str] = None,
 ) -> Callable:
     """SPMD-wrap a whole-epoch scan (``make_train_epoch(axis_name=...)``).
 
@@ -112,11 +122,13 @@ def data_parallel_train_epoch(
     return jax.jit(
         mapped,
         donate_argnums=(0, 1) if donate else (),
-        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 
 
-def data_parallel_eval_epoch(epoch_fn: Callable, mesh: Mesh) -> Callable:
+def data_parallel_eval_epoch(
+    epoch_fn: Callable, mesh: Mesh, model_name: Optional[str] = None
+) -> Callable:
     """SPMD-wrap a whole-epoch eval scan (``make_eval_epoch``)."""
     from pytorch_cifar_tpu import tpu_compiler_options
 
@@ -128,5 +140,5 @@ def data_parallel_eval_epoch(epoch_fn: Callable, mesh: Mesh) -> Callable:
         check_vma=False,
     )
     return jax.jit(
-        mapped, compiler_options=tpu_compiler_options(mesh.devices.flat[0])
+        mapped, compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name)
     )

@@ -101,7 +101,9 @@ def spatial_label_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def spatial_train_step(step_fn: Callable, mesh: Mesh, donate: bool = True):
+def spatial_train_step(
+    step_fn: Callable, mesh: Mesh, donate: bool = True, model_name=None
+):
     """jit a GLOBAL-semantics train step (built with ``axis_name=None``)
     over the 2-D mesh. GSPMD partitions every conv spatially and inserts
     halo exchanges; state stays replicated; metrics come back replicated.
@@ -118,11 +120,11 @@ def spatial_train_step(step_fn: Callable, mesh: Mesh, donate: bool = True):
         ),
         out_shardings=(replicated, replicated),
         donate_argnums=(0,) if donate else (),
-        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 
 
-def spatial_eval_step(step_fn: Callable, mesh: Mesh):
+def spatial_eval_step(step_fn: Callable, mesh: Mesh, model_name=None):
     from pytorch_cifar_tpu import tpu_compiler_options
 
     replicated = NamedSharding(mesh, P())
@@ -133,11 +135,13 @@ def spatial_eval_step(step_fn: Callable, mesh: Mesh):
             (spatial_batch_sharding(mesh), spatial_label_sharding(mesh)),
         ),
         out_shardings=replicated,
-        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 
 
-def spatial_train_epoch(epoch_fn: Callable, mesh: Mesh, donate: bool = True):
+def spatial_train_epoch(
+    epoch_fn: Callable, mesh: Mesh, donate: bool = True, model_name=None
+):
     """jit a GLOBAL-semantics whole-epoch scan over the 2-D mesh.
 
     Inputs (state, totals, dataset, perm, rng) are all replicated; the
@@ -156,11 +160,11 @@ def spatial_train_epoch(epoch_fn: Callable, mesh: Mesh, donate: bool = True):
         in_shardings=(replicated,) * 6,
         out_shardings=(replicated, replicated),
         donate_argnums=(0, 1) if donate else (),
-        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 
 
-def spatial_eval_epoch(epoch_fn: Callable, mesh: Mesh):
+def spatial_eval_epoch(epoch_fn: Callable, mesh: Mesh, model_name=None):
     from pytorch_cifar_tpu import tpu_compiler_options
 
     replicated = NamedSharding(mesh, P())
@@ -168,7 +172,7 @@ def spatial_eval_epoch(epoch_fn: Callable, mesh: Mesh):
         epoch_fn,
         in_shardings=(replicated,) * 3,
         out_shardings=replicated,
-        compiler_options=tpu_compiler_options(mesh.devices.flat[0]),
+        compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 
 

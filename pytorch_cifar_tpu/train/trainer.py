@@ -301,10 +301,18 @@ class Trainer:
             # compiler derives halo exchanges, BN reductions, grad
             # all-reduce from the sharding annotations). BN statistics are
             # globally exact here, so sync_bn has nothing to add.
-            wrap_train = lambda fn: spatial_train_step(fn, self.mesh)
-            wrap_eval = lambda fn: spatial_eval_step(fn, self.mesh)
-            wrap_train_epoch = lambda fn: spatial_train_epoch(fn, self.mesh)
-            wrap_eval_epoch = lambda fn: spatial_eval_epoch(fn, self.mesh)
+            wrap_train = lambda fn: spatial_train_step(
+                fn, self.mesh, model_name=config.model
+            )
+            wrap_eval = lambda fn: spatial_eval_step(
+                fn, self.mesh, model_name=config.model
+            )
+            wrap_train_epoch = lambda fn: spatial_train_epoch(
+                fn, self.mesh, model_name=config.model
+            )
+            wrap_eval_epoch = lambda fn: spatial_eval_epoch(
+                fn, self.mesh, model_name=config.model
+            )
             # NOTE: the spatial path keeps its per-step in-scan gather
             # (see make_train_epoch), which the DMA kernel does not serve
             epoch_kwargs = dict(
@@ -313,13 +321,17 @@ class Trainer:
         else:
             step_kwargs.update(axis_name=DATA_AXIS, sync_bn=config.sync_bn)
             eval_kwargs.update(axis_name=DATA_AXIS)
-            wrap_train = lambda fn: data_parallel_train_step(fn, self.mesh)
-            wrap_eval = lambda fn: data_parallel_eval_step(fn, self.mesh)
+            wrap_train = lambda fn: data_parallel_train_step(
+                fn, self.mesh, model_name=config.model
+            )
+            wrap_eval = lambda fn: data_parallel_eval_step(
+                fn, self.mesh, model_name=config.model
+            )
             wrap_train_epoch = lambda fn: data_parallel_train_epoch(
-                fn, self.mesh
+                fn, self.mesh, model_name=config.model
             )
             wrap_eval_epoch = lambda fn: data_parallel_eval_epoch(
-                fn, self.mesh
+                fn, self.mesh, model_name=config.model
             )
             epoch_kwargs = dict(axis_name=DATA_AXIS, n_shards=n_dev)
         if device_data:

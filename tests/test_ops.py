@@ -359,3 +359,29 @@ def test_dma_gather_wired_into_epoch_fn_jaxpr():
 
     assert "pallas_call" in jaxpr_for(True)
     assert "pallas_call" not in jaxpr_for(False)
+
+
+# ---------------------------------------------------------------------------
+# Pallas depthwise stencil (ops/depthwise_stencil.py) — interpret mode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k,c", [(7, 44), (5, 44), (3, 32), (3, 130)])
+def test_depthwise_stencil_matches_native(k, c):
+    """The stencil forward must equal XLA's grouped-conv lowering at the
+    model shapes (PNASNet k=7/5 c=44, MobileNet k=3, plus a lane-padded
+    channel count)."""
+    from pytorch_cifar_tpu.ops.depthwise_stencil import (
+        depthwise_stencil,
+        depthwise_xla,
+    )
+
+    kx, kw = jax.random.split(jax.random.PRNGKey(5))
+    x = jax.random.normal(kx, (2, 8, 8, c), jnp.float32)
+    w = jax.random.normal(kw, (k, k, c), jnp.float32) * 0.2
+    got = depthwise_stencil(x, w, True)
+    want = depthwise_xla(x, w)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )

@@ -125,6 +125,27 @@ def main() -> int:
             # path of a relaunch script must be instant.
             print(json.dumps(prev, indent=1))
             return 0
+        prev_total = prev.get("recipe", {}).get("epochs")
+        if prev_total and len(prev.get("history", [])) >= prev_total:
+            # EXTENDING a run that completed its own target (--epochs
+            # raised past the recorded curve): remove_stale_last deleted
+            # the preemption save, so only the best-acc checkpoint
+            # remains — resuming would roll back to the best epoch,
+            # truncate the curve tail, and re-train it from a non-final
+            # state. Refuse loudly; the honest way to train longer is a
+            # fresh --out. (A hard-crash resume is different: its curve
+            # is shorter than its own recipe target and stays allowed —
+            # rolling back to the last on-disk state is the documented
+            # checkpoint_every durability trade.)
+            print(
+                f"error: {args.out} holds a COMPLETED "
+                f"{prev_total}-epoch run; --resume with --epochs "
+                f"{args.epochs} would roll back to the best-acc epoch "
+                "and truncate the curve tail. Use a fresh --out to train "
+                "longer.",
+                file=sys.stderr,
+            )
+            return 2
     cfg = TrainConfig(
         model=args.model,
         lr=args.lr,

@@ -1,0 +1,139 @@
+"""A/B the Pallas depthwise stencil against XLA's native grouped conv,
+anchored by a measured VPU-peak proxy.
+
+The decision experiment for the depthwise pool (PNASNet 7x7/5x5 SepConvs,
+MobileNet 3x3s): round 3 measured native depthwise at 2.12 ms fwd
+(512,32,32,44) k=7 bf16 and quoted a ~0.6 ms roofline. That roofline is
+only reachable if the binding unit is HBM; if the native lowering already
+runs near the VPU's FMA ceiling, no stencil kernel can beat it. So this
+tool measures three things with the chained-slope protocol:
+
+1. a VPU peak proxy: a long chain of fused elementwise FMAs on a
+   VMEM-resident block — the ceiling any stencil formulation shares;
+2. native depthwise fwd (and fwd+bwd) at the model shapes;
+3. the Pallas stencil fwd (ops/depthwise_stencil.py) at the same shapes.
+
+  python tools/depthwise_bench.py                  # PNASNet shape sweep
+  python tools/depthwise_bench.py --n 512 --c 128 --k 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_cifar_tpu.ops.depthwise_stencil import (
+        depthwise_stencil,
+        depthwise_xla,
+    )
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n", type=int, default=512)
+    parser.add_argument("--h", type=int, default=32)
+    parser.add_argument("--c", type=int, default=44)
+    parser.add_argument("--k", type=int, default=7)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--max_nb", type=int, default=4)
+    parser.add_argument(
+        "--skip-vpu-peak", action="store_true",
+        help="skip the FMA-chain ceiling measurement",
+    )
+    args = parser.parse_args()
+    interpret = jax.devices()[0].platform == "cpu"
+    if interpret:  # CPU: Pallas interpret mode; clamp the work
+        args.n, args.steps, args.repeats = min(args.n, 4), 2, 1
+        args.c = min(args.c, 44)
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    shape = (args.n, args.h, args.h, args.c)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(*shape), dtype)
+    w = jnp.asarray(rs.randn(args.k, args.k, args.c), dtype)
+
+    def bench(fn, *xs):
+        out = fn(*xs)
+        jax.block_until_ready(out)
+        float(jnp.sum(out[0, 0, 0]))  # compile + real sync through tunnel
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            v = xs[0]
+            for _ in range(args.steps):
+                v = fn(v, *xs[1:])
+            float(jnp.sum(v[0, 0, 0]))  # D2H sync
+            dt = (time.perf_counter() - t0) / args.steps
+            best = min(best, dt)
+        return best * 1e3
+
+    flops = 2.0 * args.n * args.h * args.h * args.c * args.k * args.k
+
+    # 1) VPU peak proxy: R chained FMAs over the same-size array, fused by
+    # XLA into one elementwise loop — the ceiling any stencil shares.
+    # Chain length amortizes HBM (1 read + 1 write per KERNEL, not per FMA).
+    if not args.skip_vpu_peak:
+        R = 128
+
+        @jax.jit
+        def fma_chain(v):
+            a = jnp.float32(1.0000001).astype(v.dtype)
+            b = jnp.float32(1e-7).astype(v.dtype)
+            for _ in range(R):
+                v = v * a + b
+            return v
+
+        ms = bench(fma_chain, x)
+        peak = 2.0 * R * np.prod(shape) / (ms * 1e-3) / 1e12
+        print(
+            f"VPU FMA-chain proxy: {ms:.3f} ms for {R} chained FMAs over "
+            f"{shape} {args.dtype} -> {peak:.2f} TFLOP/s ceiling"
+        )
+
+    # 2) native grouped conv
+    xla_fn = jax.jit(depthwise_xla)
+    xla_ms = bench(xla_fn, x, w)
+    print(
+        f"native depthwise  k={args.k} {shape} {args.dtype}: {xla_ms:.3f} ms "
+        f"({flops / (xla_ms * 1e-3) / 1e12:.2f} TFLOP/s useful)"
+    )
+
+    # 3) Pallas stencil
+    pal = lambda v, wv: depthwise_stencil(v, wv, interpret, args.max_nb)
+    pal_ms = bench(pal, x, w)
+    print(
+        f"Pallas stencil    k={args.k} {shape} {args.dtype}: {pal_ms:.3f} ms "
+        f"({flops / (pal_ms * 1e-3) / 1e12:.2f} TFLOP/s useful)  "
+        f"speedup={xla_ms / pal_ms:.2f}x"
+    )
+
+    # numeric check at the bench shape
+    err = float(
+        jnp.max(
+            jnp.abs(
+                xla_fn(x, w).astype(jnp.float32)
+                - pal(x, w).astype(jnp.float32)
+            )
+        )
+    )
+    print(f"max|diff|={err:.3g}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

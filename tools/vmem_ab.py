@@ -1,0 +1,102 @@
+"""Per-model A/B of the scoped-VMEM compiler budget.
+
+Round 1 tuned ``xla_tpu_scoped_vmem_limit_kib=32768`` on ResNet18 (+3%)
+and applied it to every jitted bench/train step. Round 4 found it is NOT
+globally good: the same option costs merged-Inception GoogLeNet 33%
+(92.3 -> 123.2 ms/step — discovered because tools/googlenet_ab.py's
+harness lacked the option while bench.py's had it). Deeper fusion tiles
+help MXU-dense graphs and hurt pool/concat-heavy ones.
+
+This tool interleaves the budgets on ONE model in one process (the
+round-1 interleaved protocol: same data, chained donated steps, D2H
+sync, best-of alternating blocks) so the per-model winner is measured,
+not assumed.
+
+  python tools/vmem_ab.py --model GoogLeNet
+  python tools/vmem_ab.py --model DPN92 --budgets default 32768 65536
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_state, clamp_for_cpu, synthetic_batch
+    from pytorch_cifar_tpu.train.steps import make_train_step
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="GoogLeNet")
+    parser.add_argument("--batch", type=int, default=512)
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--warmup", type=int, default=10)
+    parser.add_argument("--blocks", type=int, default=3)
+    parser.add_argument(
+        "--budgets", nargs="*", default=["default", "32768", "65536"],
+        help='"default" = compiler default (16 MB); numbers are KiB',
+    )
+    args = parser.parse_args()
+    clamp_for_cpu(args)
+
+    x, y = synthetic_batch(args.batch)
+    rng = jax.random.PRNGKey(42)
+
+    arms = []
+    for b in args.budgets:
+        opts = (
+            None
+            if b == "default"
+            else {"xla_tpu_scoped_vmem_limit_kib": b}
+        )
+        state = build_state(args.model, args.batch, jnp.bfloat16)
+        step = jax.jit(
+            make_train_step(compute_dtype=jnp.bfloat16),
+            donate_argnums=(0,),
+            **({"compiler_options": opts} if opts else {}),
+        )
+        m = None
+        for _ in range(args.warmup):
+            state, m = step(state, (x, y), rng)
+        if m is not None:
+            float(m["loss_sum"])
+        arms.append([b, state, step, float("inf")])
+
+    # interleaved best-of blocks: alternating arms within the same window
+    # cancels tunnel drift between arms
+    for _ in range(args.blocks):
+        for arm in arms:
+            _, state, step, best = arm
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, m = step(state, (x, y), rng)
+            float(m["loss_sum"])
+            dt = (time.perf_counter() - t0) / args.steps
+            arm[1] = state
+            arm[3] = min(best, dt)
+
+    base = arms[0][3]
+    for b, _, _, best in arms:
+        rate = args.batch / best
+        print(
+            f"{args.model:18s} vmem={b:>7s}: {best * 1e3:7.2f} ms/step "
+            f"{rate:9.0f} img/s  ({base / best:5.2f}x vs default)",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,9 +123,13 @@ def _bench_isolated(names, args, results, flush_out, platform_cell):
             print(f"{name:20s} FAILED: {r['error']}", flush=True)
         else:
             rate = r["images_per_sec"]
+            # ms/step from the CHILD's effective batch: on CPU the child
+            # clamps --batch (clamp_for_cpu) while the parent never
+            # initializes jax and keeps the requested value
+            eff_batch = r.get("batch", args.batch)
             print(
                 f"{name:20s} {rate:10.0f} img/s  "
-                f"({args.batch * 1000 / rate:6.2f} ms/step, "
+                f"({eff_batch * 1000 / rate:6.2f} ms/step, "
                 f"isolated {wall:.0f}s)",
                 flush=True,
             )
@@ -169,6 +173,22 @@ def main() -> int:
     results = {}
     platform_cell = [None]
 
+    protocol = {
+        "steps": args.steps,
+        "warmup": args.warmup,
+        "repeats": args.repeats,
+        "isolated": isolated,
+        "note": (
+            "best-of-N step blocks, chained donated-state steps, D2H "
+            "metric sync"
+            + (
+                "; one fresh process per model (in-sweep == dedicated)"
+                if isolated
+                else "; shared process"
+            )
+        ),
+    }
+
     def flush_out():
         # incremental: a tunnel drop at model 25 of an --all sweep must not
         # discard the hours of numbers already collected
@@ -177,6 +197,7 @@ def main() -> int:
                 json.dumps(
                     {
                         "platform": platform_cell[0] or "unknown",
+                        "protocol": protocol,
                         "results": results,
                     },
                     indent=1,
