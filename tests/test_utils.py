@@ -46,3 +46,18 @@ def test_set_logger_idempotent(tmp_path):
     logging.info("hello file")
     with open(path) as f:
         assert "hello file" in f.read()
+
+
+def test_vmem_budget_table_names_are_registry_models():
+    """The per-model scoped-VMEM table (tpu_compiler_options) must only
+    name real registry models — a typo would silently fall back to the
+    compiler default and quietly lose the measured win."""
+    from pytorch_cifar_tpu import _VMEM_BUDGET_KIB
+    from pytorch_cifar_tpu.models import available_models
+
+    unknown = set(_VMEM_BUDGET_KIB) - set(available_models())
+    assert not unknown, f"non-registry names in _VMEM_BUDGET_KIB: {unknown}"
+    # values are KiB strings the XLA option accepts
+    assert all(
+        isinstance(v, str) and v.isdigit() for v in _VMEM_BUDGET_KIB.values()
+    )
