@@ -595,6 +595,12 @@ TRANSITION_CASES = [
     # ON): the optimized reduce scheduling must track torch at every point
     # of a real trajectory, not just at random init
     ("DenseNetCifar", "densenet_cifar()", 13, 6, 8),
+    # GoogLeNet in the TPU-first merged-branch Inception mode (DEFAULT
+    # ON): the merged 1x1 heads' training-mode numerics (one conv + one
+    # BN-moments reduce per cell) must track torch's per-branch execution
+    # along a trajectory; smaller point count — the model is the zoo's
+    # heaviest to compile on the CPU test platform
+    ("GoogLeNet", "GoogLeNet()", 6, 3, 4),
 ]
 
 
