@@ -61,3 +61,36 @@ def test_vmem_budget_table_names_are_registry_models():
     assert all(
         isinstance(v, str) and v.isdigit() for v in _VMEM_BUDGET_KIB.values()
     )
+
+
+def test_tpu_compiler_options_env_override_and_table(monkeypatch):
+    """The per-model budget table and the PYTORCH_CIFAR_TPU_VMEM_KIB
+    override (device injected, so the TPU branch runs on the CPU test
+    platform): env wins over the table, 'default' forces the compiler
+    default, malformed values fail with the variable named, and
+    non-TPU devices always get None."""
+    from types import SimpleNamespace
+
+    import pytest as _pytest
+
+    from pytorch_cifar_tpu import tpu_compiler_options
+
+    tpu = SimpleNamespace(platform="tpu")
+    monkeypatch.setenv("PYTORCH_CIFAR_TPU_VMEM_KIB", "default")
+    assert tpu_compiler_options(tpu, model="ResNet18") is None
+    monkeypatch.setenv("PYTORCH_CIFAR_TPU_VMEM_KIB", " 49152 ")
+    assert tpu_compiler_options(tpu) == {
+        "xla_tpu_scoped_vmem_limit_kib": "49152"
+    }
+    monkeypatch.setenv("PYTORCH_CIFAR_TPU_VMEM_KIB", "32768k")
+    with _pytest.raises(ValueError, match="VMEM_KIB"):
+        tpu_compiler_options(tpu)
+    monkeypatch.delenv("PYTORCH_CIFAR_TPU_VMEM_KIB")
+    assert tpu_compiler_options(tpu, model="ResNet18") == {
+        "xla_tpu_scoped_vmem_limit_kib": "32768"
+    }
+    assert tpu_compiler_options(tpu, model="GoogLeNet") is None  # default
+    assert (
+        tpu_compiler_options(SimpleNamespace(platform="cpu"), model="ResNet18")
+        is None
+    )
