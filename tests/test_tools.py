@@ -119,6 +119,51 @@ def test_accuracy_run_preempt_resume(tmp_path):
     assert [h["epoch"] for h in fresh["history"]] == [0]
 
 
+def test_accuracy_run_resume_survives_truncated_curve(tmp_path):
+    """A hard preemption (SIGKILL/OOM) can truncate accuracy_run.json
+    mid-write; --resume must fall back to the preemption checkpoint with
+    a warning instead of dying on JSONDecodeError (ADVICE round 4,
+    medium) — but must REFUSE when only the best-acc checkpoint remains
+    (a completed run: falling back there would roll back to the best
+    epoch and re-train/overwrite the tail). The write itself is now
+    atomic (tmp+os.replace) so this needs deliberate corruption to
+    simulate a pre-fix file or torn filesystem."""
+    out = str(tmp_path / "acc")
+    base = [
+        os.path.join(REPO, "tools", "accuracy_run.py"),
+        "--model", "LeNet", "--epochs", "3", "--batch", "64",
+        "--wallclock-only", "--out", out,
+        "--synthetic_train_size", "256", "--synthetic_test_size", "128",
+    ]
+    _run_tool(base + ["--stop-after", "2"], expected_returncode=3)
+    curve = os.path.join(out, "accuracy_run.json")
+    with open(curve) as f:
+        blob = f.read()
+    with open(curve, "w") as f:
+        f.write(blob[: len(blob) // 2])  # torn write
+    second = _run_tool(base + ["--resume"])
+    assert "unreadable" in second.stderr  # warned, not crashed
+    with open(curve) as f:
+        done = json.load(f)
+    # training state resumed from the checkpoint (epoch 2 onward); the
+    # recorded curve restarts at the resume point by design
+    assert [h["epoch"] for h in done["history"]] == [2]
+    assert done["epochs_run"] == 1
+    # the run is now COMPLETED (only the best-acc checkpoint remains):
+    # --resume with the curve deleted must refuse, not roll back
+    os.remove(curve)
+    refused = _run_tool(base + ["--resume"], expected_returncode=2)
+    assert "COMPLETED" in refused.stderr
+    # curve file absent on a genuinely PREEMPTED run (last.msgpack
+    # present) → fallback with the 'absent' warning
+    out2 = str(tmp_path / "acc2")
+    base2 = [a if a != out else out2 for a in base]
+    _run_tool(base2 + ["--stop-after", "2"], expected_returncode=3)
+    os.remove(os.path.join(out2, "accuracy_run.json"))
+    fourth = _run_tool(base2 + ["--resume"])
+    assert "absent" in fourth.stderr
+
+
 def test_zoo_bench_smoke(tmp_path):
     """zoo_bench end-to-end on CPU: clamps, benches, writes the JSON
     artifact this repo's family table is built from."""

@@ -114,9 +114,49 @@ def main() -> int:
     )
     curve_path = os.path.join(args.out, "accuracy_run.json")
     prev = None
+    curve_problem = None
     if resume and os.path.isfile(curve_path):
-        with open(curve_path) as f:
-            prev = json.load(f)
+        try:
+            with open(curve_path) as f:
+                prev = json.load(f)
+        except (ValueError, OSError) as e:
+            # ValueError covers both JSONDecodeError and the
+            # UnicodeDecodeError a byte-corrupted (not just truncated)
+            # file raises
+            # a hard preemption (SIGKILL/OOM) mid-write can truncate the
+            # curve file — the exact failure mode --resume exists to
+            # survive (the write is atomic now, but pre-fix files and torn
+            # filesystems exist). The checkpoint is the source of truth.
+            curve_problem = f"unreadable ({e})"
+    elif resume:
+        curve_problem = "absent"
+    if resume and prev is None:
+        # Without a readable curve we cannot tell a COMPLETED run (whose
+        # only checkpoint is the earlier best-acc save — resuming would
+        # roll back and re-train/overwrite the tail) from a crashed one.
+        # The preemption save disambiguates: it exists only for runs that
+        # stopped before finishing (remove_stale_last deletes it on
+        # completion).
+        if not os.path.isfile(os.path.join(args.out, LAST_NAME)):
+            print(
+                f"error: accuracy_run.json in {args.out} is "
+                f"{curve_problem} and only the best-acc checkpoint "
+                "remains — this looks like a COMPLETED run; resuming "
+                "would roll back to the best-acc epoch and re-train/"
+                "overwrite the tail. Use a fresh --out (or restore the "
+                "curve file, or delete the checkpoint to restart).",
+                file=sys.stderr,
+            )
+            return 2
+        print(
+            f"warning: accuracy_run.json in {args.out} is "
+            f"{curve_problem}; resuming from the preemption checkpoint "
+            "with an empty prior curve — earlier epochs and accumulated "
+            "wall-clock are lost from the recorded curve (training state "
+            "is unaffected)",
+            file=sys.stderr,
+        )
+    if prev is not None:
         if len(prev.get("history", [])) >= args.epochs:
             # the run already COMPLETED: the best-acc checkpoint would
             # resume from its (earlier) best epoch, re-training the tail
@@ -282,8 +322,14 @@ def _write_summary(
         ),
         "history": history,
     }
-    with open(os.path.join(args.out, "accuracy_run.json"), "w") as f:
+    # atomic tmp+rename (same rule as save_checkpoint): the curve is
+    # rewritten every epoch and re-read on --resume, so a hard preemption
+    # mid-write must never leave truncated JSON behind
+    path = os.path.join(args.out, "accuracy_run.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(summary, f, indent=1)
+    os.replace(tmp, path)
     return summary
 
 

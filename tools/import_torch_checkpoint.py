@@ -4,7 +4,7 @@ The reference checkpoints ``{'net': state_dict, 'acc': best_acc,
 'epoch': N}`` (main.py:140-147). This tool loads one (torch CPU), maps the
 weights onto the chosen registry model (``pytorch_cifar_tpu.compat``), and
 writes our ``ckpt.msgpack`` + JSON sidecar so ``train.py --resume`` (or
-``--eval_only``) continues from it. Optimizer momentum starts fresh —
+``--evaluate``) continues from it. Optimizer momentum starts fresh —
 exactly the reference's own resume semantics, which restore only
 net/acc/epoch (main.py:116-123).
 

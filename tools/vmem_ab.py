@@ -87,12 +87,16 @@ def main() -> int:
             arm[1] = state
             arm[3] = min(best, dt)
 
-    base = arms[0][3]
+    # baseline for the speedup column: the "default" arm wherever the user
+    # listed it; fall back to the first arm (with an honest label) when the
+    # budget list omits it
+    base_arm = next((a for a in arms if a[0] == "default"), arms[0])
+    base, base_name = base_arm[3], base_arm[0]
     for b, _, _, best in arms:
         rate = args.batch / best
         print(
             f"{args.model:18s} vmem={b:>7s}: {best * 1e3:7.2f} ms/step "
-            f"{rate:9.0f} img/s  ({base / best:5.2f}x vs default)",
+            f"{rate:9.0f} img/s  ({base / best:5.2f}x vs {base_name})",
             flush=True,
         )
     return 0
