@@ -160,6 +160,80 @@ def _flax_signature(kind: str, p_node):
     return ("bn", tuple(p_node["scale"].shape), True)
 
 
+def _pair_with_groups(
+    name: str,
+    params,
+    groups,
+    num_classes: int,
+    src_desc: str,
+):
+    """The ONE pairing loop both directions share: align our ``name``
+    model's recorded call order with torch ``groups`` by first-fit within
+    each (kind, shape-signature) class. Any change to the alignment
+    strategy lives here, so import∘export stays a bijection by
+    construction.
+
+    ``params=None`` pairs against a fresh init (the import direction).
+    Returns ``(pairs, used, params, stats)``: pairs are
+    ``(kind, path, p_node, torch_prefix, group_dict, linear_i)`` in call order
+    (``linear_i`` indexes LINEAR_FLATTEN; None for non-linears), ``used``
+    masks the consumed groups, and params/stats are the trees the
+    p_node references point into. Raises when one of our nodes finds no
+    group — ``src_desc`` names the torch side in the error.
+    """
+    import jax
+
+    from pytorch_cifar_tpu.models import create_model
+
+    model = create_model(
+        name, num_classes=num_classes, **stock_execution_kwargs(name)
+    )
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    order, variables = record_call_order(model, x)
+    if params is None:
+        params = jax.tree_util.tree_map(
+            np.asarray, dict(variables["params"])
+        )
+    stats = jax.tree_util.tree_map(
+        np.asarray, dict(variables.get("batch_stats", {}))
+    )
+    used = [False] * len(groups)
+    pairs = []
+    linear_i = 0
+    for kind, path in order:
+        p_node = _node_at(params, path)
+        if p_node is None:
+            raise ValueError(f"no param node at {path} for recorded {kind}")
+        sig = _flax_signature(kind, p_node)
+        for gi, (tk, tprefix, g) in enumerate(groups):
+            if used[gi] or tk != kind:
+                continue
+            if _torch_signature(tk, g) != sig:
+                continue
+            used[gi] = True
+            pairs.append(
+                (
+                    kind,
+                    path,
+                    p_node,
+                    tprefix,
+                    g,
+                    linear_i if kind == "linear" else None,
+                )
+            )
+            break
+        else:
+            raise ValueError(
+                f"{src_desc} has no unused {kind} of signature {sig} for "
+                f"our node {'/'.join(path)} — wrong --model? (Alignment "
+                "is only guaranteed for the reference zoo; see "
+                "import_torch_state_dict's SCOPE note.)"
+            )
+        if kind == "linear":
+            linear_i += 1
+    return pairs, used, params, stats
+
+
 def import_torch_state_dict(
     name: str,
     state_dict: Mapping[str, np.ndarray],
@@ -180,69 +254,36 @@ def import_torch_state_dict(
     but loads the wrong tensors. Validate non-zoo imports with a forward
     cross-check against the donor model's outputs.
     """
-    from pytorch_cifar_tpu.models import create_model
-
-    import jax
-
-    model = create_model(
-        name, num_classes=num_classes, **stock_execution_kwargs(name)
-    )
-    x = np.zeros((2, 32, 32, 3), np.float32)
-    order, variables = record_call_order(model, x)
-    params = jax.tree_util.tree_map(np.asarray, dict(variables["params"]))
-    stats = jax.tree_util.tree_map(
-        np.asarray, dict(variables.get("batch_stats", {}))
-    )
     groups = _torch_groups(state_dict)
-    used = [False] * len(groups)
-    linear_i = 0
+    pairs, used, params, stats = _pair_with_groups(
+        name, None, groups, num_classes, src_desc="state_dict"
+    )
     flatten = LINEAR_FLATTEN.get(name, {})
-
-    for kind, path in order:
-        p_node = _node_at(params, path)
-        if p_node is None:
-            raise ValueError(f"no param node at {path} for recorded {kind}")
-        sig = _flax_signature(kind, p_node)
-        for gi, (tk, tprefix, g) in enumerate(groups):
-            if used[gi] or tk != kind:
-                continue
-            if _torch_signature(tk, g) != sig:
-                continue
-            used[gi] = True
-            if kind == "conv":
-                p_node["kernel"] = np.transpose(g["weight"], (2, 3, 1, 0))
-                if "bias" in g:
-                    p_node["bias"] = g["bias"]
-            elif kind == "linear":
-                w = g["weight"]
-                if linear_i in flatten:
-                    c, h, wd = flatten[linear_i]
-                    w = (
-                        w.reshape(-1, c, h, wd)
-                        .transpose(0, 2, 3, 1)
-                        .reshape(w.shape[0], -1)
-                    )
-                p_node["kernel"] = w.T
-                if "bias" in g:
-                    p_node["bias"] = g["bias"]
-            else:
-                p_node["scale"] = g["weight"]
+    for kind, path, p_node, _tprefix, g, linear_i in pairs:
+        if kind == "conv":
+            p_node["kernel"] = np.transpose(g["weight"], (2, 3, 1, 0))
+            if "bias" in g:
                 p_node["bias"] = g["bias"]
-                s_node = _node_at(stats, path)
-                if s_node is None:
-                    raise ValueError(f"no batch_stats node at {path}")
-                s_node["mean"] = g["running_mean"]
-                s_node["var"] = g["running_var"]
-            break
+        elif kind == "linear":
+            w = g["weight"]
+            if linear_i in flatten:
+                c, h, wd = flatten[linear_i]
+                w = (
+                    w.reshape(-1, c, h, wd)
+                    .transpose(0, 2, 3, 1)
+                    .reshape(w.shape[0], -1)
+                )
+            p_node["kernel"] = w.T
+            if "bias" in g:
+                p_node["bias"] = g["bias"]
         else:
-            raise ValueError(
-                f"state_dict has no unused {kind} of signature {sig} for "
-                f"our node {'/'.join(path)} — wrong --model for this "
-                "checkpoint? (Alignment is only guaranteed for the "
-                "reference zoo; see import_torch_state_dict's SCOPE note.)"
-            )
-        if kind == "linear":
-            linear_i += 1
+            p_node["scale"] = g["weight"]
+            p_node["bias"] = g["bias"]
+            s_node = _node_at(stats, path)
+            if s_node is None:
+                raise ValueError(f"no batch_stats node at {path}")
+            s_node["mean"] = g["running_mean"]
+            s_node["var"] = g["running_var"]
 
     report = {
         "unmatched_torch_modules": [
@@ -252,3 +293,92 @@ def import_torch_state_dict(
         ]
     }
     return params, stats, report
+
+
+def export_torch_state_dict(
+    name: str,
+    params,
+    batch_stats,
+    template_sd: Mapping[str, np.ndarray],
+    num_classes: int = 10,
+) -> Dict[str, np.ndarray]:
+    """Map OUR ``name`` model's trees onto a torch ``state_dict`` — the
+    exact inverse of :func:`import_torch_state_dict`, so anything trained
+    here becomes loadable by the reference's own ``--resume``
+    (main.py:77-84: ``net.load_state_dict(checkpoint['net'])``).
+
+    ``template_sd`` supplies the torch key names, definition order, shapes
+    and dtypes (build it from a freshly-constructed reference model's
+    ``state_dict()``; values are ignored). The same call-order +
+    first-fit-within-shape-class pairing as the importer is used — the
+    pairing is a bijection, so export∘import and import∘export are
+    identity on the reference zoo (pinned in tests/test_compat.py).
+    ``num_batches_tracked`` leaves are emitted as zeros: torch only reads
+    them under ``momentum=None``, which no zoo model uses.
+
+    Returns a flat dict in the template's key order (bare keys — the CLI
+    adds the reference's DataParallel ``module.`` prefix). Raises if any
+    template module finds no source node (a strict ``load_state_dict``
+    would be handed an uninitialized tensor) or any of our recorded nodes
+    finds no template slot (wrong --model for this template).
+    """
+    template_sd, _ = normalize_state_dict(template_sd)
+    groups = _torch_groups(template_sd)
+    pairs, used, _, _ = _pair_with_groups(
+        name, params, groups, num_classes, src_desc="template state_dict"
+    )
+    flatten = LINEAR_FLATTEN.get(name, {})
+    by_prefix: Dict[str, Dict[str, np.ndarray]] = {}
+
+    for kind, path, p_node, tprefix, g, linear_i in pairs:
+        out: Dict[str, np.ndarray] = {}
+        if kind == "conv":
+            out["weight"] = np.transpose(
+                np.asarray(p_node["kernel"]), (3, 2, 0, 1)
+            )
+            if "bias" in g:
+                out["bias"] = np.asarray(p_node["bias"])
+        elif kind == "linear":
+            w = np.asarray(p_node["kernel"]).T  # (out, in_nhwc)
+            if linear_i in flatten:
+                c, h, wd = flatten[linear_i]
+                w = (
+                    w.reshape(-1, h, wd, c)
+                    .transpose(0, 3, 1, 2)
+                    .reshape(w.shape[0], -1)
+                )
+            out["weight"] = w
+            if "bias" in g:
+                out["bias"] = np.asarray(p_node["bias"])
+        else:
+            s_node = _node_at(batch_stats, path)
+            if s_node is None:
+                raise ValueError(f"no batch_stats node at {path}")
+            out["weight"] = np.asarray(p_node["scale"])
+            out["bias"] = np.asarray(p_node["bias"])
+            out["running_mean"] = np.asarray(s_node["mean"])
+            out["running_var"] = np.asarray(s_node["var"])
+        by_prefix[tprefix] = out
+
+    unused = [
+        f"{tprefix} ({tk})"
+        for (tk, tprefix, _), u in zip(groups, used)
+        if not u
+    ]
+    if unused:
+        raise ValueError(
+            "template modules with no source node (strict load_state_dict "
+            f"would receive uninitialized tensors): {unused}"
+        )
+
+    result: Dict[str, np.ndarray] = {}
+    for k, v in template_sd.items():
+        if k.endswith("num_batches_tracked"):
+            result[k] = np.zeros((), np.asarray(v).dtype)
+            continue
+        prefix, _, leaf = k.rpartition(".")
+        val = by_prefix[prefix][leaf]
+        result[k] = np.ascontiguousarray(
+            val.astype(np.asarray(v).dtype, copy=False)
+        )
+    return result

@@ -68,6 +68,26 @@ def save_checkpoint(
     return path
 
 
+def newest_checkpoint_order(output_dir: str):
+    """Checkpoint preference for training resume: whichever of
+    last.msgpack / ckpt.msgpack has the newer epoch in its meta sidecar
+    (ties go to the preemption save — it has the exact latest opt state).
+    An unreadable/corrupt sidecar counts as epoch -1 instead of raising,
+    so a torn write never blocks resume. Shared by Trainer and
+    tools/export_torch_checkpoint.py so the rule cannot drift."""
+
+    def epoch_of(name):
+        try:
+            with open(meta_path(output_dir, name)) as f:
+                return int(json.load(f).get("epoch", -1))
+        except (OSError, ValueError):
+            return -1
+
+    if epoch_of(LAST_NAME) >= epoch_of(CKPT_NAME):
+        return [LAST_NAME, CKPT_NAME]
+    return [CKPT_NAME, LAST_NAME]
+
+
 def remove_stale_last(output_dir: str) -> None:
     """Delete the preemption save (last.msgpack + sidecar) after a run
     COMPLETES normally: a leftover one would make a routine relaunch with

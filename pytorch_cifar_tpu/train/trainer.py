@@ -404,22 +404,12 @@ class Trainer:
 
     @staticmethod
     def _resume_order(output_dir: str):
-        """Checkpoint preference for training resume: whichever of
-        last.msgpack / ckpt.msgpack has the newer epoch in its meta sidecar
-        (ties go to the preemption save — it has the exact latest opt
-        state)."""
-        import json as _json
+        """See checkpoint.newest_checkpoint_order (shared rule)."""
+        from pytorch_cifar_tpu.train.checkpoint import (
+            newest_checkpoint_order,
+        )
 
-        def epoch_of(name):
-            try:
-                with open(meta_path(output_dir, name)) as f:
-                    return int(_json.load(f).get("epoch", -1))
-            except (OSError, ValueError):
-                return -1
-
-        if epoch_of(LAST_NAME) >= epoch_of(CKPT_NAME):
-            return [LAST_NAME, CKPT_NAME]
-        return [CKPT_NAME, LAST_NAME]
+        return newest_checkpoint_order(output_dir)
 
     def train_epoch(self, epoch: int) -> Tuple[float, float]:
         if self.train_epoch_fn is not None:

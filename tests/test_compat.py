@@ -119,6 +119,171 @@ def test_wrong_model_fails_loudly():
         import_torch_state_dict("ResNet18", sd)
 
 
+def _our_randomized_model(name):
+    """Our ``name`` model with random params and non-trivial BN stats."""
+    import jax
+
+    from pytorch_cifar_tpu.models import create_model
+
+    model = create_model(name)
+    x = np.zeros((2, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(3), x, train=False)
+    params = jax.tree_util.tree_map(np.asarray, dict(variables["params"]))
+    rs = np.random.RandomState(7)
+    stats = jax.tree_util.tree_map(
+        lambda a: rs.uniform(0.6, 1.4, a.shape).astype(a.dtype),
+        dict(variables.get("batch_stats", {})),
+    )
+    # means negative-ish, vars positive: walk the tree and flip the sign
+    # range for 'mean' leaves so the two stat kinds differ
+    def fix(node):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                fix(v)
+            elif k == "mean":
+                node[k] = (v - 1.0).astype(v.dtype) * 0.2
+    fix(stats)
+    return model, params, stats
+
+
+@pytest.mark.parametrize(
+    "name,expr",
+    [
+        ("LeNet", "LeNet()"),
+        ("ResNet18", "ResNet18()"),
+        ("PreActResNet18", "PreActResNet18()"),
+        ("GoogLeNet", "GoogLeNet()"),
+        ("EfficientNetB0", "EfficientNetB0()"),
+    ],
+)
+def test_export_torch_loads_and_round_trips(name, expr):
+    """export_torch_state_dict makes OUR weights loadable by the real
+    reference model (strict load_state_dict), forward-matching our
+    network, and import(export(x)) is the identity — the full portable-
+    validation story (VERDICT round 4 #2): train on TPU here, verify on
+    any torch box with data. LeNet exercises the inverse NHWC->NCHW
+    flatten permutation; EfficientNetB0 the dead expand convs;
+    PreActResNet18 the call-vs-definition order divergence; GoogLeNet
+    exports from the default merged execution's (identical) param tree."""
+    from pytorch_cifar_tpu.compat import (
+        export_torch_state_dict,
+        import_torch_state_dict,
+    )
+
+    model, params, stats = _our_randomized_model(name)
+    tmodel = _randomized_ref_model(expr)
+    template = {
+        k: v.detach().cpu().numpy() for k, v in tmodel.state_dict().items()
+    }
+    sd = export_torch_state_dict(name, params, stats, template)
+    # every template key present, original order preserved (strict load)
+    assert list(sd) == list(template)
+
+    missing, unexpected = tmodel.load_state_dict(
+        {k: torch.from_numpy(np.copy(v)) for k, v in sd.items()},
+        strict=True,
+    )
+    assert not missing and not unexpected
+    tmodel.eval()
+
+    x_nhwc = np.random.RandomState(0).rand(4, 32, 32, 3).astype(np.float32)
+    ours = np.asarray(
+        model.apply(
+            {"params": params, "batch_stats": stats}, x_nhwc, train=False
+        ),
+        np.float32,
+    )
+    tx = torch.from_numpy(
+        np.ascontiguousarray(np.transpose(x_nhwc, (0, 3, 1, 2)))
+    )
+    with torch.no_grad():
+        theirs = tmodel(tx).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-3)
+
+    # import(export(x)) == x, bit-exact (the pairing is a bijection)
+    import jax
+
+    params2, stats2, report = import_torch_state_dict(name, sd)
+    assert report["unmatched_torch_modules"] == []
+    for orig, back in ((params, params2), (stats, stats2)):
+        a = {
+            jax.tree_util.keystr(p): np.asarray(v)
+            for p, v in jax.tree_util.tree_leaves_with_path(orig)
+        }
+        b = {
+            jax.tree_util.keystr(p): np.asarray(v)
+            for p, v in jax.tree_util.tree_leaves_with_path(back)
+        }
+        assert a.keys() == b.keys()
+        for k in a:
+            assert np.array_equal(a[k], b[k]), f"{name}: {k} round-trip"
+
+
+def test_export_cli_writes_reference_loadable_pth(tmp_path):
+    """End-to-end CLI: our checkpoint dir -> export tool -> ckpt.pth that
+    the reference's resume path accepts verbatim (DataParallel 'module.'
+    keys, {'net','acc','epoch'} envelope, main.py:77-84,140-147)."""
+    import jax
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.1, t_max=200, steps_per_epoch=98)
+    state = create_train_state(model, jax.random.PRNGKey(5), tx)
+    out_dir = tmp_path / "ckpt"
+    save_checkpoint(str(out_dir), state, epoch=7, best_acc=88.25)
+
+    pth = tmp_path / "exported.pth"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "tools", "export_torch_checkpoint.py"),
+            "--ckpt", str(out_dir), "--model", "LeNet", "--out", str(pth),
+        ],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=repo,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    obj = torch.load(str(pth), map_location="cpu", weights_only=True)
+    assert obj["acc"] == 88.25 and obj["epoch"] == 7
+    assert all(k.startswith("module.") for k in obj["net"])
+
+    # the reference's own resume shape: DataParallel wrapper, strict load
+    net = torch.nn.DataParallel(_randomized_ref_model("LeNet()"))
+    missing, unexpected = net.load_state_dict(obj["net"], strict=True)
+    assert not missing and not unexpected
+    net.eval()
+
+    x_nhwc = np.random.RandomState(1).rand(4, 32, 32, 3).astype(np.float32)
+    ours = np.asarray(
+        model.apply(
+            {
+                "params": jax.device_get(state.params),
+                "batch_stats": jax.device_get(state.batch_stats),
+            },
+            x_nhwc,
+            train=False,
+        ),
+        np.float32,
+    )
+    with torch.no_grad():
+        theirs = net(
+            torch.from_numpy(
+                np.ascontiguousarray(np.transpose(x_nhwc, (0, 3, 1, 2)))
+            )
+        ).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-3)
+
+
 def test_import_cli_writes_resumable_checkpoint(tmp_path):
     """End-to-end: reference-style ckpt.pth -> CLI tool -> our checkpoint
     restores into a TrainState with the imported weights and meta."""

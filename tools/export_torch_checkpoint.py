@@ -1,0 +1,200 @@
+"""Export one of OUR checkpoints as a reference-format torch ``ckpt.pth``.
+
+The reverse of ``tools/import_torch_checkpoint.py``: reads our
+``ckpt.msgpack`` (+ JSON sidecar), maps the weights onto the reference's
+torch ``state_dict`` layout (``pytorch_cifar_tpu.compat``), and writes
+``{'net': state_dict, 'acc': best_acc, 'epoch': epoch}`` with
+DataParallel ``module.``-prefixed keys — exactly what the reference's own
+``--resume`` loads (main.py:77-84,140-147). That makes anything trained
+here verifiable on ANY torch box with real data: train on TPU, export,
+``python main.py --resume`` elsewhere.
+
+Needs torch and a reference checkout (for the state_dict template — key
+names and definition order come from the real torch model):
+
+    python tools/export_torch_checkpoint.py \
+        --ckpt ./checkpoint --model ResNet18 --out ckpt.pth
+    python tools/export_torch_checkpoint.py \
+        --ckpt ./checkpoint/last.msgpack --model ResNet18 --out ckpt.pth \
+        --ref /path/to/pytorch-cifar
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def reference_factory_expr(name: str) -> str:
+    """The reference ``models`` factory expression for a registry name.
+
+    Most registry names ARE the reference factory (``ResNet18()``); the
+    table holds the exceptions. ShuffleNetG2/G3 have no expression: the
+    reference cannot instantiate them under Python 3 (float mid_planes
+    TypeError, models/shufflenet.py:27), so there is no torch template to
+    export against.
+    """
+    if name.startswith("VGG"):
+        return f"VGG('{name}')"
+    if name.startswith("ShuffleNetV2_"):
+        return f"ShuffleNetV2(net_size={name.split('_', 1)[1]})"
+    if name == "DenseNetCifar":
+        return "densenet_cifar()"
+    if name in ("ShuffleNetG2", "ShuffleNetG3"):
+        raise SystemExit(
+            f"{name}: the reference's own factory is Python-3-broken "
+            "(models/shufflenet.py:27 float mid_planes), so no torch "
+            "template exists to export against."
+        )
+    return f"{name}()"
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import honor_platform_env
+
+    honor_platform_env()
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--ckpt", required=True,
+        help="our checkpoint: a dir holding ckpt.msgpack (newest of "
+        "ckpt/last picked, like --resume) or a direct .msgpack path",
+    )
+    parser.add_argument("--model", required=True, help="registry model name")
+    parser.add_argument("--out", required=True, help="output ckpt.pth path")
+    parser.add_argument("--num_classes", type=int, default=10)
+    parser.add_argument(
+        "--ref", default=os.environ.get("REFERENCE_DIR", "/root/reference"),
+        help="reference checkout providing the torch model definitions",
+    )
+    parser.add_argument(
+        "--ref_expr", default=None,
+        help="override the reference factory expression "
+        "(e.g. \"ShuffleNetV2(net_size=0.5)\")",
+    )
+    parser.add_argument(
+        "--acc", type=float, default=None,
+        help="override the 'acc' field (default: the sidecar's best_acc)",
+    )
+    parser.add_argument(
+        "--epoch", type=int, default=None,
+        help="override the 'epoch' field (default: the sidecar's epoch)",
+    )
+    parser.add_argument(
+        "--no-module-prefix", action="store_true",
+        help="write bare keys instead of DataParallel 'module.' ones",
+    )
+    args = parser.parse_args()
+
+    try:
+        import torch
+    except ImportError:
+        print("error: torch is required to write ckpt.pth", file=sys.stderr)
+        return 1
+
+    if args.num_classes != 10 and not args.ref_expr:
+        print(
+            "error: the reference zoo factories are 10-class; a "
+            f"--num_classes {args.num_classes} template needs an explicit "
+            "--ref_expr building the matching torch model",
+            file=sys.stderr,
+        )
+        return 1
+
+    # -- our checkpoint -> host trees -------------------------------------
+    from flax import serialization
+
+    from pytorch_cifar_tpu.train.checkpoint import (
+        CKPT_NAME,
+        LAST_NAME,
+        newest_checkpoint_order,
+    )
+
+    ckpt_path = args.ckpt
+    if os.path.isdir(ckpt_path):
+        # the trainer's own newest-wins --resume rule (shared helper:
+        # larger sidecar epoch wins, tie -> the preemption save, corrupt
+        # sidecar counts as epoch -1)
+        for name in newest_checkpoint_order(ckpt_path):
+            p = os.path.join(ckpt_path, name)
+            if os.path.isfile(p):
+                ckpt_path = p
+                break
+        else:
+            print(
+                f"error: no {CKPT_NAME} or {LAST_NAME} in {ckpt_path}",
+                file=sys.stderr,
+            )
+            return 1
+    with open(ckpt_path, "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    params, batch_stats = tree["params"], tree.get("batch_stats", {})
+
+    acc, epoch = args.acc, args.epoch
+    sidecar = os.path.splitext(ckpt_path)[0] + ".json"
+    try:
+        with open(sidecar) as f:
+            meta = json.load(f)
+        if acc is None:
+            acc = float(meta.get("best_acc", 0.0))
+        if epoch is None:
+            epoch = int(meta.get("epoch", 0))
+    except (OSError, ValueError):
+        pass  # corrupt/absent sidecar: fall through to the defaults
+    acc = 0.0 if acc is None else acc
+    epoch = 0 if epoch is None else epoch
+
+    # -- torch template from the reference checkout -----------------------
+    if not os.path.isdir(os.path.join(args.ref, "models")):
+        print(
+            f"error: no reference checkout at {args.ref} (need its models/ "
+            "package for the state_dict template); pass --ref",
+            file=sys.stderr,
+        )
+        return 1
+    if args.ref not in sys.path:
+        sys.path.insert(0, args.ref)
+    import models as ref_models
+
+    expr = args.ref_expr or reference_factory_expr(args.model)
+    tmodel = eval(expr, {**vars(ref_models)})  # noqa: S307 — user's own repo
+    template = {
+        k: v.detach().cpu().numpy() for k, v in tmodel.state_dict().items()
+    }
+
+    from pytorch_cifar_tpu.compat import export_torch_state_dict
+
+    sd_np = export_torch_state_dict(
+        args.model, params, batch_stats, template,
+        num_classes=args.num_classes,
+    )
+    prefix = "" if args.no_module_prefix else "module."
+    sd = {prefix + k: torch.from_numpy(np.copy(v)) for k, v in sd_np.items()}
+
+    out_dir = os.path.dirname(os.path.abspath(args.out))
+    os.makedirs(out_dir, exist_ok=True)
+    torch.save({"net": sd, "acc": acc, "epoch": epoch}, args.out)
+    print(
+        json.dumps(
+            {
+                "out": args.out,
+                "model": args.model,
+                "tensors": len(sd),
+                "acc": acc,
+                "epoch": epoch,
+                "source": ckpt_path,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
