@@ -6,15 +6,25 @@ config 2 (single chip, batch 512). The reference publishes no numbers
 is no reference value to divide by; the driver's BENCH_r{N}.json history is
 the comparison series across rounds.
 
-What is timed: the full jitted training iteration exactly as the trainer runs
-it — on-device uint8 decode + random-crop/flip augmentation, bf16 forward,
-loss, backward, SGD+momentum+wd+cosine update, metric accumulation — with
-donated state, over pre-staged device batches (isolates device throughput,
-the per-chip metric; the host input pipeline is benchmarked separately by
-tests/test_data.py and scales with host cores, not chips).
+DEFAULT (since round 5): the PRODUCTION path — whole epochs through the
+Trainer (device-resident dataset, one-dispatch epoch scan, the program a
+real training run executes), reported as the MEDIAN of ``--captures``
+fresh-process runs. Rounds 1-4 measured a standalone per-step program in
+one process; that both missed the production path's round-3/4 gains
+(33.0k -> 38.1k while the step number sat at 36.5k) and carried ±2%
+single-capture tunnel noise — larger than the effect sizes being shipped.
+The per-step program remains as ``--step``; the first round-5 capture
+reports both (``step_value`` field) so the series discontinuity is
+documented in the BENCH history itself. Per-capture values land on
+stderr; capture-to-capture spread is reported as ``spread_pct``.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+``--step`` times the full jitted training iteration exactly as the trainer
+runs it — on-device uint8 decode + random-crop/flip augmentation, bf16
+forward, loss, backward, SGD+momentum+wd+cosine update, metric
+accumulation — with donated state, over pre-staged device batches.
+
+Prints ONE JSON line (stdout):
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N, ...}
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.json:
 "published": {}), so the baseline is the OLDEST capture of the SAME metric
@@ -353,11 +363,103 @@ def prior_round_value(metric: str):
     return best[1] if best else None
 
 
-def main() -> int:
-    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+def core_record(metric: str, value: float) -> dict:
+    """The driver-parsed record shape, shared by headline() and main() so
+    the contract cannot drift between the two emitters."""
+    prior = prior_round_value(metric)
+    return {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / prior, 4) if prior else 1.0,
+    }
 
-    honor_platform_env()
-    enable_compilation_cache()
+
+def headline(args) -> int:
+    """The default scoreboard protocol: median of ``--captures`` fresh
+    subprocess runs of the production epoch path, plus one ``--step``
+    cross-walk capture (TPU only). This parent NEVER initializes a jax
+    backend — the exclusive chip must belong to one child at a time, and
+    a parent holding the tunnel would serialize against its own children.
+    """
+    import statistics
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    base = [
+        sys.executable, here,
+        "--model", args.model,
+        "--batch", str(args.batch),
+        "--dtype", args.dtype,
+        "--repeats", str(args.repeats),
+    ]
+
+    def run_child(extra):
+        try:
+            r = subprocess.run(
+                base + extra, capture_output=True, text=True, timeout=3600
+            )
+        except subprocess.TimeoutExpired as e:
+            # keep the child's partial output — it is the only diagnostic
+            # of a tunnel stall, and the driver records our tail
+            for stream in (e.stdout, e.stderr):
+                if stream:
+                    sys.stderr.write(str(stream)[-4000:] + "\n")
+            sys.stderr.write(f"error: bench child timed out: {extra}\n")
+            raise SystemExit(1)
+        if r.returncode != 0:
+            sys.stderr.write(r.stdout[-2000:] + "\n" + r.stderr[-4000:])
+            raise SystemExit(r.returncode or 1)
+        lines = [
+            ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")
+        ]
+        if not lines:
+            sys.stderr.write(r.stdout[-2000:] + "\n" + r.stderr[-4000:])
+            sys.stderr.write(f"error: bench child printed no JSON: {extra}\n")
+            raise SystemExit(1)
+        return json.loads(lines[-1])
+
+    captures, metric = [], None
+    for i in range(max(args.captures, 1)):
+        rec = run_child(["--epoch"])
+        metric = rec["metric"]
+        captures.append(rec["value"])
+        # no "/N" denominator: a CPU smoke stops after one capture, so the
+        # planned count would mislead anyone tailing the log
+        print(
+            f"capture {i + 1}: {rec['value']:.2f} img/s/chip ({metric})",
+            file=sys.stderr,
+        )
+        if metric.endswith("_cpu"):
+            break  # CPU invocations are smoke runs: one capture, no x-walk
+
+    value = statistics.median(captures)
+    out = core_record(metric, value)
+    out["captures"] = [round(c, 2) for c in captures]
+    out["spread_pct"] = round(
+        (max(captures) - min(captures)) / value * 100, 2
+    ) if len(captures) > 1 else 0.0
+    if not metric.endswith("_cpu"):
+        srec = run_child(
+            [
+                "--step",
+                "--steps", str(args.steps),
+                "--warmup", str(args.warmup),
+            ]
+        )
+        print(
+            f"step cross-walk: {srec['value']:.2f} img/s/chip "
+            f"({srec['metric']})",
+            file=sys.stderr,
+        )
+        out["step_metric"] = srec["metric"]
+        out["step_value"] = srec["value"]
+        out["step_vs_baseline"] = srec["vs_baseline"]
+    print(json.dumps(out))
+    return 0
+
+
+def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default="ResNet18")
     parser.add_argument("--batch", type=int, default=512)
@@ -385,10 +487,37 @@ def main() -> int:
     parser.add_argument(
         "--epoch", action="store_true",
         help="measure whole-epoch throughput through the Trainer's "
-        "production path (device-resident data + one-dispatch epoch scan)",
+        "production path (device-resident data + one-dispatch epoch scan), "
+        "one in-process capture (the default headline runs this in "
+        "--captures fresh subprocesses and takes the median)",
+    )
+    parser.add_argument(
+        "--step", action="store_true",
+        help="measure the standalone per-step program in-process "
+        "(the rounds-1-4 headline protocol)",
+    )
+    parser.add_argument(
+        "--captures", type=int, default=3,
+        help="fresh-process captures for the default headline (median "
+        "wins; the persistent compile cache keeps reruns ~30s each)",
     )
     args = parser.parse_args()
 
+    if not (
+        args.pipeline
+        or args.eval
+        or args.epoch
+        or args.step
+        or args.config is not None
+    ):
+        # the scoreboard default: orchestrate fresh-process captures of the
+        # production path; never touch a jax backend from this process
+        return headline(args)
+
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
     platform = clamp_for_cpu(args)
 
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
@@ -434,17 +563,7 @@ def main() -> int:
 
     if not args.pipeline:
         metric = f"{name}_{args.dtype}_{platform}"
-    prior = prior_round_value(metric)
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(value / prior, 4) if prior else 1.0,
-            }
-        )
-    )
+    print(json.dumps(core_record(metric, value)))
     return 0
 
 

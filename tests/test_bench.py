@@ -17,12 +17,48 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_prints_one_json_line():
+def test_bench_default_headline_prints_one_json_line():
+    """The round-5+ scoreboard default: fresh-process captures of the
+    production epoch path, median reported, ONE JSON line on stdout (the
+    driver parses it; capture logs go to stderr). On CPU it is a one-
+    capture smoke with no step cross-walk."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
-         "--steps", "2", "--warmup", "1", "--batch", "64"],
+         "--batch", "64", "--repeats", "1"],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env=env,
+        check=True,
+    )
+    json_lines = [
+        l for l in out.stdout.splitlines() if l.strip().startswith("{")
+    ]
+    assert len(json_lines) == 1, out.stdout
+    rec = json.loads(json_lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["value"] > 0
+    assert rec["metric"].startswith("epoch_throughput_LeNet"), rec["metric"]
+    # JAX_PLATFORMS=cpu must be honored — the exclusive TPU chip may be in
+    # use by another process while tests run; CPU smoke = one capture
+    assert rec["metric"].endswith("_cpu"), rec["metric"]
+    assert rec["captures"] == [rec["value"]]
+    assert "step_value" not in rec  # cross-walk is a TPU-only extra
+    assert "capture 1:" in out.stderr
+
+
+def test_bench_step_mode_prints_one_json_line():
+    """--step preserves the rounds-1-4 per-step program and its exact
+    4-key JSON contract (its metric name carries the historical series)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
+         "--steps", "2", "--warmup", "1", "--batch", "64", "--step"],
         capture_output=True,
         text=True,
         timeout=600,
@@ -38,9 +74,7 @@ def test_bench_prints_one_json_line():
     assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
     assert rec["unit"] == "images/sec/chip"
     assert rec["value"] > 0
-    assert "LeNet" in rec["metric"]
-    # JAX_PLATFORMS=cpu must be honored — the exclusive TPU chip may be in
-    # use by another process while tests run
+    assert rec["metric"].startswith("train_throughput_LeNet"), rec["metric"]
     assert rec["metric"].endswith("_cpu"), rec["metric"]
 
 
