@@ -404,7 +404,9 @@ def headline(args) -> int:
             # of a tunnel stall, and the driver records our tail
             for stream in (e.stdout, e.stderr):
                 if stream:
-                    sys.stderr.write(str(stream)[-4000:] + "\n")
+                    if isinstance(stream, bytes):  # POSIX leaves these raw
+                        stream = stream.decode(errors="replace")
+                    sys.stderr.write(stream[-4000:] + "\n")
             sys.stderr.write(f"error: bench child timed out: {extra}\n")
             raise SystemExit(1)
         if r.returncode != 0:
@@ -499,7 +501,8 @@ def main() -> int:
     parser.add_argument(
         "--captures", type=int, default=3,
         help="fresh-process captures for the default headline (median "
-        "wins; the persistent compile cache keeps reruns ~30s each)",
+        "wins; ~60-80s each warm — the compile cache skips compilation "
+        "but every fresh process re-pays the one-time dataset staging)",
     )
     args = parser.parse_args()
 

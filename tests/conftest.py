@@ -39,6 +39,59 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# slow-test marking (VERDICT round 4 #8): `pytest -m "not slow"` is the
+# sub-5-minute inner loop; the full suite (~55 min on the 1-core CI VM) stays
+# the CI gate. Centralized here — measured from --durations=80 (round 5) —
+# so no test file carries its own marker bookkeeping. Everything in
+# SLOW_MODULES, plus the named tests in otherwise-fast modules, is `slow`.
+# ---------------------------------------------------------------------------
+
+SLOW_MODULES = {
+    "test_tools.py",         # subprocess CLI drives, ~15 min
+    "test_torch_parity.py",  # torch+reference transplants, ~11 min
+    "test_multihost.py",     # real 2-process rendezvous, ~3 min
+    "test_compat.py",        # state_dict round-trips, ~5 min with exporter
+    "test_spatial.py",       # mesh exactness + HLO lowering, ~4 min
+}
+SLOW_TESTS = {
+    "test_parallel.py": (
+        "test_graft_entry_dryrun_multichip",
+        "test_graft_entry_single_chip",
+        "test_sync_bn_matches_global_batch_stats",
+        "test_augmentation_decorrelated_across_shards",
+    ),
+    "test_models.py": (
+        "test_forward_shape",
+        "test_efficientnet_stochastic_depth_train_step",
+        "test_googlenet_merged_1x1_matches_stock",
+        "test_densenet_shared_stats_matches_stock",
+    ),
+    "test_trainer.py": (
+        "test_epoch_compiled_matches_step_loop",
+        "test_fit_trains_and_checkpoints",
+        "test_pipelined_fit_finalizes_pending_epoch_on_crash",
+    ),
+    "test_ops.py": ("test_conv_bn_relu",),
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: integration-weight test excluded from the -m 'not slow' "
+        "inner loop (full suite remains the CI gate)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        if fname in SLOW_MODULES or any(
+            item.name.startswith(p) for p in SLOW_TESTS.get(fname, ())
+        ):
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session")
 def cifar_synthetic():

@@ -29,7 +29,10 @@ def test_bench_default_headline_prints_one_json_line():
          "--batch", "64", "--repeats", "1"],
         capture_output=True,
         text=True,
-        timeout=600,
+        # the child compiles the whole-epoch Trainer program; a cold
+        # compile cache on the 1-core CI VM can take far longer than the
+        # tiny per-step program the old default test compiled
+        timeout=1500,
         cwd=REPO,
         env=env,
         check=True,
