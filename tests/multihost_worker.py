@@ -7,8 +7,11 @@ with broadcast restore — on CPU devices. This is the rendezvous topology the
 reference needed a live NCCL cluster to exercise (main_dist.py:51-82);
 here it runs inside CI.
 
-Usage: multihost_worker.py <pid> <nproc> <port>  (nproc=1: single-process
-comparator producing the same global computation on one process.)
+Usage: multihost_worker.py <pid> <nproc> <port> <out_dir> [mode]
+(nproc=1: single-process comparator producing the same global computation
+on one process. mode="restore": skip training and restore the checkpoint
+another topology wrote into <out_dir> — the cross-topology resume case,
+e.g. preemption onto a different slice shape.)
 
 Prints one JSON line: {"loss": ..., "count": ..., "psum": ..., "resumed_epoch": ...}
 """
@@ -24,6 +27,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def main() -> int:
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     out_dir = sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "train"
 
     from pytorch_cifar_tpu import honor_platform_env
     from pytorch_cifar_tpu.parallel.mesh import initialize_distributed
@@ -79,6 +83,35 @@ def main() -> int:
         make_train_step(axis_name=DATA_AXIS), mesh
     )
     eval_step = data_parallel_eval_step(make_eval_step(axis_name=DATA_AXIS), mesh)
+
+    if mode == "restore":
+        # cross-topology resume: restore a checkpoint that a DIFFERENT
+        # mesh/process topology wrote. Checkpoints are host-side pytrees,
+        # so the restore must be bit-exact regardless of the saving
+        # topology; eval over the restored state pins the semantic.
+        state2, start_epoch, best_acc = restore_checkpoint(out_dir, state)
+        ev = jax.device_get(
+            eval_step(state2, put_global(te_x, te_y, sharding))
+        )
+        psum = float(
+            sum(
+                np.abs(np.asarray(jax.device_get(p), np.float64)).sum()
+                for p in jax.tree_util.tree_leaves(state2.params)
+            )
+        )
+        print(
+            json.dumps(
+                {
+                    "pid": pid,
+                    "psum": psum,
+                    "resumed_epoch": start_epoch,
+                    "best_acc": best_acc,
+                    "eval_acc": float(ev["correct"]) / float(ev["count"]),
+                }
+            ),
+            flush=True,
+        )
+        return 0
 
     rng = jax.random.PRNGKey(1)
     metrics = None
