@@ -164,6 +164,41 @@ def test_accuracy_run_resume_survives_truncated_curve(tmp_path):
     assert "absent" in fourth.stderr
 
 
+def test_export_reference_factory_expr_covers_registry(monkeypatch):
+    """The exporter's registry-name -> reference-factory mapping: EVERY
+    registry entry resolves to an expression (or the documented
+    ShuffleNetG2/G3 SystemExit — the reference's own Py3-broken factory),
+    and the non-trivial name transforms are exact. Iterating the real
+    registry means a future entry whose factory is not ``<name>()`` fails
+    here, not at export time on some torch box."""
+    import pytest
+
+    monkeypatch.syspath_prepend(os.path.join(REPO, "tools"))
+    from export_torch_checkpoint import reference_factory_expr
+    from pytorch_cifar_tpu.models import MODEL_REGISTRY
+
+    broken = {"ShuffleNetG2", "ShuffleNetG3"}
+    for name in MODEL_REGISTRY:
+        if name in broken:
+            with pytest.raises(SystemExit):
+                reference_factory_expr(name)
+        else:
+            expr = reference_factory_expr(name)
+            assert expr and "(" in expr, (name, expr)
+
+    assert reference_factory_expr("ResNet18") == "ResNet18()"
+    assert reference_factory_expr("VGG13") == "VGG('VGG13')"
+    assert reference_factory_expr("DenseNetCifar") == "densenet_cifar()"
+    assert (
+        reference_factory_expr("ShuffleNetV2_0.5")
+        == "ShuffleNetV2(net_size=0.5)"
+    )
+    assert (
+        reference_factory_expr("ShuffleNetV2_1.5")
+        == "ShuffleNetV2(net_size=1.5)"
+    )
+
+
 def test_zoo_bench_smoke(tmp_path):
     """zoo_bench end-to-end on CPU: clamps, benches, writes the JSON
     artifact this repo's family table is built from."""
