@@ -154,6 +154,10 @@ def _our_randomized_model(name):
         ("PreActResNet18", "PreActResNet18()"),
         ("GoogLeNet", "GoogLeNet()"),
         ("EfficientNetB0", "EfficientNetB0()"),
+        # channel-split/shuffle layout + the dotted registry name
+        ("ShuffleNetV2_0.5", "ShuffleNetV2(net_size=0.5)"),
+        # dual-path concat growth + grouped 3x3s
+        ("DPN26", "DPN26()"),
     ],
 )
 def test_export_torch_loads_and_round_trips(name, expr):
