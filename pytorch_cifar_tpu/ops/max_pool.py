@@ -72,6 +72,95 @@ from pytorch_cifar_tpu.ops.blocking import batch_chunk, channel_chunk, pad_chann
 _NEG = float("-inf")
 
 
+def _w_taps_roll(x, w):
+    """The three W-axis taps (left-neighbor, center, right-neighbor) via
+    hardware sublane rotates instead of misaligned shifted slices — the
+    round-3 binding constraint was load+load+funnel-shift per shifted
+    vreg access; ``pltpu.roll`` lowers to a single rotate. Wrapped edge
+    columns are replaced with -inf by a broadcasted-iota select (pure
+    register work)."""
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 2)
+    neg = jnp.full(x.shape, _NEG, x.dtype)
+    left = jnp.where(col == 0, neg, pltpu.roll(x, 1, 2))  # tap k=0: x[j-1]
+    # rotation is modular; pltpu.roll rejects negative shifts, so -1 == w-1
+    right = jnp.where(col == w - 1, neg, pltpu.roll(x, w - 1, 2))  # k=2
+    return left, x, right
+
+
+def _fwd_kernel_roll(x_ref, out_ref, ih_ref=None, iw_ref=None, *, h, w):
+    # Same separable decomposition and tie rule as _fwd_kernel; the W-pass
+    # reads its shifted taps via sublane rotates (_w_taps_roll). The
+    # h-pass keeps plain slices — h is outside the (sublane, lane) vreg
+    # tile, so its shifted reads are aligned address arithmetic.
+    x = x_ref[...].astype(jnp.float32)
+    t0, t1, t2 = _w_taps_roll(x, w)
+    mh = t0
+    iw = jnp.zeros(mh.shape, jnp.float32) if iw_ref is not None else None
+    for k, cur in ((1, t1), (2, t2)):
+        m = cur > mh  # strict: earlier tap keeps ties
+        if iw is not None:
+            iw = jnp.where(m, jnp.float32(k), iw)
+        mh = jnp.where(m, cur, mh)
+    mhp = jnp.pad(
+        mh, [(0, 0), (1, 1), (0, 0), (0, 0)], constant_values=_NEG
+    )
+    best = mhp[:, 0:h, :, :]
+    ih = jnp.zeros(best.shape, jnp.float32) if ih_ref is not None else None
+    for k in range(1, 3):
+        cur = mhp[:, k : k + h, :, :]
+        m = cur > best
+        if ih is not None:
+            ih = jnp.where(m, jnp.float32(k), ih)
+        best = jnp.where(m, cur, best)
+    out_ref[...] = best.astype(out_ref.dtype)
+    if ih_ref is not None:
+        ih_ref[...] = ih.astype(ih_ref.dtype)
+        iw_ref[...] = iw.astype(iw_ref.dtype)
+
+
+def _bwd_kernel_roll(g_ref, ih_ref, iw_ref, gi_ref, *, h, w):
+    # Mirror of _bwd_kernel with the W-pass shifted reads as rotates.
+    # h-pass: plain slices (aligned). w-pass: input column j receives the
+    # intermediate gradient of window j+1-k iff that window's w-winner is
+    # k; the shifted reads of (gmh, iw) become rotates with edge columns
+    # neutralized (iw edge -> 3.0 never matches; gmh edge -> 0).
+    g = g_ref[...].astype(jnp.float32)
+    gp = jnp.pad(g, [(0, 0), (1, 1), (0, 0), (0, 0)])
+    ihp = jnp.pad(
+        ih_ref[...].astype(jnp.float32),
+        [(0, 0), (1, 1), (0, 0), (0, 0)],
+        constant_values=3.0,
+    )
+    gmh = None
+    for k in range(3):
+        sl_h = slice(2 - k, 2 - k + h)
+        hit = ihp[:, sl_h, :, :] == jnp.float32(k)
+        term = jnp.where(hit, gp[:, sl_h, :, :], jnp.float32(0))
+        gmh = term if gmh is None else gmh + term
+    iw = iw_ref[...].astype(jnp.float32)
+    col = jax.lax.broadcasted_iota(jnp.int32, gmh.shape, 2)
+    acc = None
+    for k in range(3):
+        # slice(2-k, 2-k+w) of the pad-1 array reads original j+1-k,
+        # i.e. roll(x, k-1)[j] == x[j-(k-1)] == x[j+1-k]
+        shift = k - 1
+        if shift == 0:
+            gm_s, iw_s = gmh, iw
+        else:
+            edge = w - 1 if shift < 0 else 0
+            sh = shift % w  # pltpu.roll rejects negative shifts
+            gm_s = jnp.where(
+                col == edge, jnp.float32(0), pltpu.roll(gmh, sh, 2)
+            )
+            iw_s = jnp.where(
+                col == edge, jnp.float32(3), pltpu.roll(iw, sh, 2)
+            )
+        hit = iw_s == jnp.float32(k)
+        term = jnp.where(hit, gm_s, jnp.float32(0))
+        acc = term if acc is None else acc + term
+    gi_ref[...] = acc.astype(gi_ref.dtype)
+
+
 def _fwd_kernel(x_ref, out_ref, ih_ref=None, iw_ref=None, *, h, w):
     # x_ref: (nb, h, w, c) unpadded input tile; out/ih/iw: (nb, h, w, c).
     # ih/iw are None for the forward-only (inference) variant — the winner
@@ -183,14 +272,18 @@ def _batch_chunk(n: int) -> int:
 _pad_channels = pad_channels
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "emit_idx"))
-def _max_pool3x3_fwd(x, interpret=False, emit_idx=True):
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "emit_idx", "use_roll")
+)
+def _max_pool3x3_fwd(x, interpret=False, emit_idx=True, use_roll=False):
     n, h, w, _ = x.shape
     cb = _chunk(x.shape[-1])
     x, c = _pad_channels(x, cb)
     cp = x.shape[-1]
     nb = _batch_chunk(n)
-    kernel = functools.partial(_fwd_kernel, h=h, w=w)
+    kernel = functools.partial(
+        _fwd_kernel_roll if use_roll else _fwd_kernel, h=h, w=w
+    )
     grid = (n // nb, cp // cb)
     out_spec = _spec((nb, h, w, cb))
     out_shape = jax.ShapeDtypeStruct((n, h, w, cp), x.dtype)
@@ -219,8 +312,8 @@ def _max_pool3x3_fwd(x, interpret=False, emit_idx=True):
     return out[..., :c], None
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _max_pool3x3_bwd(g, ih, iw, interpret=False):
+@functools.partial(jax.jit, static_argnames=("interpret", "use_roll"))
+def _max_pool3x3_bwd(g, ih, iw, interpret=False, use_roll=False):
     n, h, w, _ = g.shape
     cb = _chunk(g.shape[-1])
     g, c = _pad_channels(g, cb)
@@ -228,7 +321,9 @@ def _max_pool3x3_bwd(g, ih, iw, interpret=False):
     iw, _ = _pad_channels(iw, cb)
     cp = g.shape[-1]
     nb = _batch_chunk(n)
-    kernel = functools.partial(_bwd_kernel, h=h, w=w)
+    kernel = functools.partial(
+        _bwd_kernel_roll if use_roll else _bwd_kernel, h=h, w=w
+    )
     out = pl.pallas_call(
         kernel,
         grid=(n // nb, cp // cb),
@@ -244,22 +339,34 @@ def _max_pool3x3_bwd(g, ih, iw, interpret=False):
     return out[..., :c]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def max_pool3x3_s1(x, interpret: bool = False):
-    """3x3/stride-1/pad-1 max pool, NHWC, Pallas fwd+bwd."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def max_pool3x3_s1(x, interpret: bool = False, use_roll: bool = False):
+    """3x3/stride-1/pad-1 max pool, NHWC, Pallas fwd+bwd.
+
+    ``use_roll``: W-axis shifted taps via hardware sublane rotates
+    (pltpu.roll) instead of misaligned shifted slices — see
+    _w_taps_roll. Measured on the v5e (tools/pool_bench.py benches all
+    three arms): 20.33 ms vs the slice kernel's 20.43 — a measured
+    non-win, so the default stays False and nn.max_pool stays shipped
+    (BENCHMARKS.md round 5).
+    """
     # primal-only call (no differentiation): skip the winner-index output
-    out, _ = _max_pool3x3_fwd(x, interpret=interpret, emit_idx=False)
+    out, _ = _max_pool3x3_fwd(
+        x, interpret=interpret, emit_idx=False, use_roll=use_roll
+    )
     return out
 
 
-def _vjp_fwd(x, interpret):
-    out, idx = _max_pool3x3_fwd(x, interpret=interpret)
+def _vjp_fwd(x, interpret, use_roll):
+    out, idx = _max_pool3x3_fwd(x, interpret=interpret, use_roll=use_roll)
     return out, idx
 
 
-def _vjp_bwd(interpret, idx, g):
+def _vjp_bwd(interpret, use_roll, idx, g):
     ih, iw = idx
-    return (_max_pool3x3_bwd(g, ih, iw, interpret=interpret),)
+    return (
+        _max_pool3x3_bwd(g, ih, iw, interpret=interpret, use_roll=use_roll),
+    )
 
 
 max_pool3x3_s1.defvjp(_vjp_fwd, _vjp_bwd)

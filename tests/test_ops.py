@@ -76,11 +76,12 @@ def _xla_pool(x):
     return nn.max_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
 
 
-def test_max_pool3x3_forward_matches_xla():
+@pytest.mark.parametrize("use_roll", [False, True], ids=["slice", "roll"])
+def test_max_pool3x3_forward_matches_xla(use_roll):
     from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
 
     x = jax.random.normal(jax.random.PRNGKey(3), (3, 8, 8, 16))
-    got = max_pool3x3_s1(x, True)
+    got = max_pool3x3_s1(x, True, use_roll)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(_xla_pool(x)))
 
 
@@ -94,7 +95,8 @@ def test_max_pool3x3_forward_nonaligned_channels():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(_xla_pool(x)))
 
 
-def test_max_pool3x3_gradient_matches_select_and_scatter():
+@pytest.mark.parametrize("use_roll", [False, True], ids=["slice", "roll"])
+def test_max_pool3x3_gradient_matches_select_and_scatter(use_roll):
     from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
 
     # fp32 random data has no ties, and integer-valued cotangents make
@@ -108,7 +110,7 @@ def test_max_pool3x3_gradient_matches_select_and_scatter():
         jax.random.uniform(jax.random.PRNGKey(7), x.shape) * 8.0
     )
     _, vjp_ref = jax.vjp(_xla_pool, x)
-    _, vjp_new = jax.vjp(lambda x: max_pool3x3_s1(x, True), x)
+    _, vjp_new = jax.vjp(lambda x: max_pool3x3_s1(x, True, use_roll), x)
     np.testing.assert_array_equal(
         np.asarray(vjp_new(g)[0]), np.asarray(vjp_ref(g)[0])
     )
@@ -121,7 +123,8 @@ def test_max_pool3x3_gradient_matches_select_and_scatter():
     )
 
 
-def test_max_pool3x3_gradient_tie_rule_first_max():
+@pytest.mark.parametrize("use_roll", [False, True], ids=["slice", "roll"])
+def test_max_pool3x3_gradient_tie_rule_first_max(use_roll):
     from pytorch_cifar_tpu.ops.max_pool import max_pool3x3_s1
 
     # all-equal input: EVERY window tap ties, so the gradient routing is
@@ -133,7 +136,7 @@ def test_max_pool3x3_gradient_tie_rule_first_max():
         jax.random.uniform(jax.random.PRNGKey(9), x.shape) * 8.0
     )
     _, vjp_ref = jax.vjp(_xla_pool, x)
-    _, vjp_new = jax.vjp(lambda x: max_pool3x3_s1(x, True), x)
+    _, vjp_new = jax.vjp(lambda x: max_pool3x3_s1(x, True, use_roll), x)
     np.testing.assert_array_equal(
         np.asarray(vjp_new(g)[0]), np.asarray(vjp_ref(g)[0])
     )

@@ -82,17 +82,23 @@ def main() -> int:
         return best * 1e3
 
     pallas_pool = lambda v: max_pool3x3_s1(v, interpret)
+    roll_pool = lambda v: max_pool3x3_s1(v, interpret, True)
     xla_ms = bench(make_fwd_bwd(xla_pool), x)
     pal_ms = bench(make_fwd_bwd(pallas_pool), x)
+    roll_ms = bench(make_fwd_bwd(roll_pool), x)
     # numeric check at the bench shape (not just the unit-test shapes)
     g1 = make_fwd_bwd(xla_pool)(x)
     g2 = make_fwd_bwd(pallas_pool)(x)
+    g3 = make_fwd_bwd(roll_pool)(x)
     err = float(jnp.max(jnp.abs(g1.astype(jnp.float32) - g2.astype(jnp.float32))))
+    err_r = float(jnp.max(jnp.abs(g1.astype(jnp.float32) - g3.astype(jnp.float32))))
     print(
         f"shape={shape} dtype={args.dtype}  "
         f"XLA(select-and-scatter)={xla_ms:.2f} ms  "
         f"Pallas(winner-index)={pal_ms:.2f} ms  "
-        f"speedup={xla_ms / pal_ms:.2f}x  max|dgrad|={err:.3g}"
+        f"Pallas(sublane-roll)={roll_ms:.2f} ms  "
+        f"speedup={xla_ms / pal_ms:.2f}x / {xla_ms / roll_ms:.2f}x  "
+        f"max|dgrad|={err:.3g} / {err_r:.3g}"
     )
     return 0
 
