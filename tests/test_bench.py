@@ -17,22 +17,16 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_default_headline_prints_one_json_line():
-    """The round-5+ scoreboard default: fresh-process captures of the
-    production epoch path, median reported, ONE JSON line on stdout (the
-    driver parses it; capture logs go to stderr). On CPU it is a one-
-    capture smoke with no step cross-walk."""
+def run_bench(args, timeout=600):
+    """Run bench.py CPU-pinned and return the single stdout JSON record
+    (the driver contract: exactly ONE JSON line on stdout)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
-         "--batch", "64", "--repeats", "1"],
+        [sys.executable, os.path.join(REPO, "bench.py"), *args],
         capture_output=True,
         text=True,
-        # the child compiles the whole-epoch Trainer program; a cold
-        # compile cache on the 1-core CI VM can take far longer than the
-        # tiny per-step program the old default test compiled
-        timeout=1500,
+        timeout=timeout,
         cwd=REPO,
         env=env,
         check=True,
@@ -41,7 +35,21 @@ def test_bench_default_headline_prints_one_json_line():
         l for l in out.stdout.splitlines() if l.strip().startswith("{")
     ]
     assert len(json_lines) == 1, out.stdout
-    rec = json.loads(json_lines[0])
+    return json.loads(json_lines[0]), out
+
+
+def test_bench_default_headline_prints_one_json_line():
+    """The round-5+ scoreboard default: fresh-process captures of the
+    production epoch path, median reported, ONE JSON line on stdout (the
+    driver parses it; capture logs go to stderr). On CPU it is a one-
+    capture smoke with no step cross-walk."""
+    # timeout 1500: the child compiles the whole-epoch Trainer program; a
+    # cold compile cache on the 1-core CI VM takes far longer than the
+    # tiny per-step program the other modes compile
+    rec, out = run_bench(
+        ["--model", "LeNet", "--batch", "64", "--repeats", "1"],
+        timeout=1500,
+    )
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["unit"] == "images/sec/chip"
     assert rec["value"] > 0
@@ -57,23 +65,10 @@ def test_bench_default_headline_prints_one_json_line():
 def test_bench_step_mode_prints_one_json_line():
     """--step preserves the rounds-1-4 per-step program and its exact
     4-key JSON contract (its metric name carries the historical series)."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
-         "--steps", "2", "--warmup", "1", "--batch", "64", "--step"],
-        capture_output=True,
-        text=True,
-        timeout=600,
-        cwd=REPO,
-        env=env,
-        check=True,
+    rec, _ = run_bench(
+        ["--model", "LeNet", "--steps", "2", "--warmup", "1",
+         "--batch", "64", "--step"]
     )
-    json_lines = [
-        l for l in out.stdout.splitlines() if l.strip().startswith("{")
-    ]
-    assert len(json_lines) == 1, out.stdout
-    rec = json.loads(json_lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
     assert rec["unit"] == "images/sec/chip"
     assert rec["value"] > 0
@@ -117,45 +112,36 @@ def test_real_bench_r01_is_picked_up():
 
 
 def test_bench_eval_mode_prints_one_json_line():
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
-         "--steps", "2", "--warmup", "1", "--batch", "64", "--eval"],
-        capture_output=True,
-        text=True,
-        timeout=600,
-        cwd=REPO,
-        env=env,
-        check=True,
+    rec, _ = run_bench(
+        ["--model", "LeNet", "--steps", "2", "--warmup", "1",
+         "--batch", "64", "--eval"]
     )
-    json_lines = [
-        l for l in out.stdout.splitlines() if l.strip().startswith("{")
-    ]
-    assert len(json_lines) == 1, out.stdout
-    rec = json.loads(json_lines[0])
     assert rec["metric"].startswith("eval_throughput_LeNet"), rec["metric"]
     assert rec["value"] > 0
 
 
-def test_bench_epoch_mode_prints_one_json_line():
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--model", "LeNet",
-         "--epoch", "--batch", "128", "--repeats", "1"],
-        capture_output=True,
-        text=True,
-        timeout=600,
-        cwd=REPO,
-        env=env,
-        check=True,
+def test_bench_pipeline_mode_prints_one_json_line():
+    # no --steps: bench floors pipeline steps to 20 and drains whole
+    # epochs regardless, so a steps arg would be decorative
+    rec, _ = run_bench(["--pipeline", "--batch", "64"])
+    # no dtype component: the pipeline moves uint8 regardless of --dtype
+    assert rec["metric"] == "host_pipeline_b64_cpu", rec["metric"]
+    assert rec["value"] > 0
+
+
+def test_bench_config_mode_prints_one_json_line():
+    rec, _ = run_bench(
+        ["--config", "1", "--steps", "2", "--warmup", "1", "--batch", "64"]
     )
-    json_lines = [
-        l for l in out.stdout.splitlines() if l.strip().startswith("{")
-    ]
-    assert len(json_lines) == 1, out.stdout
-    rec = json.loads(json_lines[0])
+    assert rec["metric"].startswith("config1_LeNet"), rec["metric"]
+    assert rec["metric"].endswith("_cpu"), rec["metric"]
+    assert rec["value"] > 0
+
+
+def test_bench_epoch_mode_prints_one_json_line():
+    rec, _ = run_bench(
+        ["--model", "LeNet", "--epoch", "--batch", "128", "--repeats", "1"]
+    )
     assert rec["metric"].startswith("epoch_throughput_LeNet_b128")
     assert rec["metric"].endswith("_cpu")
     assert rec["value"] > 0
