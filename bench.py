@@ -23,6 +23,11 @@ runs it — on-device uint8 decode + random-crop/flip augmentation, bf16
 forward, loss, backward, SGD+momentum+wd+cosine update, metric
 accumulation — with donated state, over pre-staged device batches.
 
+``--serve`` is the second first-class metric (round 6): closed-loop
+request latency + img/s through the inference serving stack (bucket-
+compiled engine + micro-batcher, serve/ + SERVING.md), with
+p50/p95/p99 latency riding along in the same single-line JSON record.
+
 Prints ONE JSON line (stdout):
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N, ...}
 
@@ -335,6 +340,43 @@ def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
     return done * batch / elapsed
 
 
+def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
+    """Serving-side north-star: closed-loop request latency + img/s
+    through the full serve stack (bucket-compiled engine + micro-batcher;
+    serve/ and SERVING.md). Random-init weights — serving throughput
+    depends on the compiled program, not the parameter values. Returns
+    the loadgen report plus the config keys the metric name needs."""
+    from pytorch_cifar_tpu.serve import InferenceEngine, MicroBatcher
+    from pytorch_cifar_tpu.serve.loadgen import run_load
+
+    max_b = min(128, batch)
+    buckets = tuple(sorted({b for b in (1, 8, 32, max_b) if b <= max_b}))
+    engine = InferenceEngine.from_random(
+        model, buckets=buckets, compute_dtype=compute_dtype
+    )
+    batcher = MicroBatcher(
+        engine, max_batch=max_b, max_wait_ms=2.0, max_queue=8 * max_b
+    )
+    try:
+        run_load(  # warmup pass: page in the executables under threads
+            batcher, clients=2, requests_per_client=2, seed=1
+        )
+        report = run_load(
+            batcher,
+            clients=8,
+            requests_per_client=max(steps, 2),
+            images_max=8,
+            seed=0,
+        )
+    finally:
+        batcher.close()
+    assert engine.compile_count == len(engine.buckets), (
+        "serving bench recompiled after warmup"
+    )
+    report["max_batch"] = max_b
+    return report
+
+
 def prior_round_value(metric: str):
     """OLDEST recorded BENCH_r{N}.json value for this exact metric.
 
@@ -375,6 +417,26 @@ def core_record(metric: str, value: float) -> dict:
     }
 
 
+def parse_child_record(stdout: str):
+    """The LAST stdout line that parses as a JSON object carrying the
+    driver contract's known keys ('metric', 'value'). Defensive by
+    design (ADVICE round 5): a stray brace-prefixed log line from a
+    dependency must be skipped, not parsed as the bench record or allowed
+    to crash json.loads. Returns None when no line qualifies."""
+    rec = None
+    for ln in stdout.splitlines():
+        s = ln.strip()
+        if not s.startswith("{"):
+            continue
+        try:
+            cand = json.loads(s)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand and "value" in cand:
+            rec = cand
+    return rec
+
+
 def headline(args) -> int:
     """The default scoreboard protocol: median of ``--captures`` fresh
     subprocess runs of the production epoch path, plus one ``--step``
@@ -412,14 +474,15 @@ def headline(args) -> int:
         if r.returncode != 0:
             sys.stderr.write(r.stdout[-2000:] + "\n" + r.stderr[-4000:])
             raise SystemExit(r.returncode or 1)
-        lines = [
-            ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")
-        ]
-        if not lines:
+        rec = parse_child_record(r.stdout)
+        if rec is None:
             sys.stderr.write(r.stdout[-2000:] + "\n" + r.stderr[-4000:])
-            sys.stderr.write(f"error: bench child printed no JSON: {extra}\n")
+            sys.stderr.write(
+                f"error: bench child printed no metric/value JSON record: "
+                f"{extra}\n"
+            )
             raise SystemExit(1)
-        return json.loads(lines[-1])
+        return rec
 
     captures, metric = [], None
     for i in range(max(args.captures, 1)):
@@ -499,6 +562,12 @@ def main() -> int:
         "(the rounds-1-4 headline protocol)",
     )
     parser.add_argument(
+        "--serve", action="store_true",
+        help="measure inference SERVING latency/throughput through the "
+        "bucket-compiled engine + micro-batcher (serve/, SERVING.md): "
+        "closed-loop synthetic clients, p50/p95/p99 latency in the record",
+    )
+    parser.add_argument(
         "--captures", type=int, default=3,
         help="fresh-process captures for the default headline (median "
         "wins; ~60-80s each warm — the compile cache skips compilation "
@@ -511,6 +580,7 @@ def main() -> int:
         or args.eval
         or args.epoch
         or args.step
+        or args.serve
         or args.config is not None
     ):
         # the scoreboard default: orchestrate fresh-process captures of the
@@ -525,11 +595,26 @@ def main() -> int:
 
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
+    extra = {}
     if args.pipeline:
         value = run_pipeline(args.batch, max(args.steps, 20))
         # no dtype component: the pipeline moves uint8 regardless of --dtype,
         # and the round-over-round series must not fragment on an unused flag
         metric = f"host_pipeline_b{args.batch}_{platform}"
+    elif args.serve:
+        report = run_serve(args.model, args.batch, args.steps, compute_dtype)
+        value = report["img_per_sec"]
+        # latency SLO percentiles ride along in the same single-line record
+        extra = {
+            k: round(report[k], 3)
+            for k in ("p50_ms", "p95_ms", "p99_ms", "mean_ms")
+        }
+        extra.update(
+            requests=report["requests"],
+            rejected=report["rejected"],
+            clients=report["clients"],
+        )
+        name = f"serve_throughput_{args.model}_b{report['max_batch']}"
     elif args.config is not None:
         models, batch = CONFIGS[args.config]
         batch = min(batch, args.batch) if platform == "cpu" else batch
@@ -566,7 +651,9 @@ def main() -> int:
 
     if not args.pipeline:
         metric = f"{name}_{args.dtype}_{platform}"
-    print(json.dumps(core_record(metric, value)))
+    rec = core_record(metric, value)
+    rec.update(extra)
+    print(json.dumps(rec))
     return 0
 
 

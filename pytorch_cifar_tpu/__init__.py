@@ -19,6 +19,61 @@ __version__ = "0.1.0"
 from pytorch_cifar_tpu.config import TrainConfig  # noqa: F401
 
 
+def _xla_supports_flag(flag_name: str) -> bool:
+    """True when the installed jaxlib's XLA knows ``flag_name``.
+
+    XLA *aborts the process* (parse_flags_from_env.cc) on any unknown
+    flag in XLA_FLAGS, so optional tuning flags must be probed before
+    being set — a version of jaxlib that predates a flag turns every
+    entry point into an instant crash otherwise (observed with the CPU
+    collective-timeout flags on jaxlib 0.4.36). Flag names are embedded
+    verbatim in the xla_extension shared object as registration strings;
+    a byte scan of that file is the only probe that cannot itself abort.
+    The result is cached in the environment so child processes (bench
+    captures, multihost workers) skip the scan.
+    """
+    import glob
+    import mmap
+    import os
+
+    cache_key = "PYTORCH_CIFAR_TPU_XLAFLAG_" + flag_name.upper()
+    cached = os.environ.get(cache_key)
+    if cached in ("0", "1"):
+        return cached == "1"
+    supported = False
+    try:
+        import jaxlib
+
+        pattern = os.path.join(
+            os.path.dirname(jaxlib.__file__), "xla_extension*.so"
+        )
+        needle = flag_name.encode()
+        for so in glob.glob(pattern):
+            with open(so, "rb") as f, mmap.mmap(
+                f.fileno(), 0, access=mmap.ACCESS_READ
+            ) as m:
+                if m.find(needle) != -1:
+                    supported = True
+                    break
+    except Exception:
+        supported = False  # cannot verify -> never risk the abort
+    os.environ[cache_key] = "1" if supported else "0"
+    return supported
+
+
+def xla_collective_timeout_flags() -> str:
+    """The CPU collective liveness-timeout flags, or '' when the
+    installed XLA does not know them (setting unknown flags aborts; see
+    :func:`_xla_supports_flag`). Shared by honor_platform_env and
+    tests/conftest.py so the support gate cannot drift."""
+    if _xla_supports_flag("xla_cpu_collective_call_terminate_timeout_seconds"):
+        return (
+            "--xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
+            " --xla_cpu_collective_call_terminate_timeout_seconds=300"
+        )
+    return ""
+
+
 def honor_platform_env() -> None:
     """Make ``JAX_PLATFORMS=cpu`` effective even when a site-installed TPU
     plugin overrides it at interpreter startup.
@@ -37,14 +92,13 @@ def honor_platform_env() -> None:
         # (this CI VM has ONE core under 8 virtual devices) a straggler
         # partition can legitimately take longer than that to reach an
         # all-reduce while its peers spin-wait. Liveness timeouts, not
-        # correctness: raise them before the backend reads XLA_FLAGS.
+        # correctness: raise them before the backend reads XLA_FLAGS —
+        # but only when this jaxlib KNOWS the flags (unknown XLA_FLAGS
+        # abort the process, strictly worse than the timeout they tune).
         flags = os.environ.get("XLA_FLAGS", "")
-        if "collective_call_terminate" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags
-                + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
-                " --xla_cpu_collective_call_terminate_timeout_seconds=300"
-            ).strip()
+        timeout_flags = xla_collective_timeout_flags()
+        if timeout_flags and "collective_call_terminate" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + timeout_flags).strip()
 
         import jax
 

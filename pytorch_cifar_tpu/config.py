@@ -140,8 +140,48 @@ class TrainConfig:
         return self.cosine_t_max if self.cosine_t_max is not None else self.epochs
 
 
-def _add_args(parser: argparse.ArgumentParser) -> None:
-    for f in dataclasses.fields(TrainConfig):
+@dataclass
+class ServeConfig:
+    """Configuration for the inference serving engine (serve.py; see
+    SERVING.md for the tuning guidance behind each knob)."""
+
+    model: str = "ResNet18"
+    ckpt: str = "./checkpoint"  # Trainer output dir, .msgpack, or ckpt.pth
+    num_classes: int = 10
+
+    # engine: one AOT-compiled forward per bucket; partial batches pad up
+    # to the nearest bucket, so after warmup NO request shape compiles
+    buckets: Tuple[int, ...] = (1, 8, 32, 128)
+    dtype: str = "bfloat16"  # serving compute dtype; logits return fp32
+    mean: Tuple[float, float, float] = (0.4914, 0.4822, 0.4465)
+    std: Tuple[float, float, float] = (0.2023, 0.1994, 0.2010)
+
+    # micro-batcher: coalesce up to max_batch images per dispatch, waiting
+    # at most max_wait_ms after the first queued request; admission
+    # control rejects once max_queue images are waiting (backpressure)
+    max_batch: int = 0  # 0 = the largest bucket
+    max_wait_ms: float = 2.0
+    max_queue: int = 1024
+
+    # checkpoint hot-reload: poll ckpt for a newer best checkpoint and
+    # swap params atomically (in-flight requests keep their weights)
+    watch: bool = False
+    poll_s: float = 1.0
+
+    # synthetic closed-loop load (serve.py demo / bench.py --serve)
+    clients: int = 8
+    requests: int = 64  # per client
+    request_images_max: int = 8  # request size ~ U[1, this]
+    duration_s: float = 0.0  # optional wall-clock cap (0 = none)
+    seed: int = 0
+
+    # verify bit-identity of the padded bucket path against a direct
+    # unpadded jitted forward before serving (one extra compile)
+    verify: bool = False
+
+
+def _add_args(parser: argparse.ArgumentParser, cls=TrainConfig) -> None:
+    for f in dataclasses.fields(cls):
         name = "--" + f.name
         if f.type == "bool" or isinstance(f.default, bool):
             parser.add_argument(
@@ -151,17 +191,36 @@ def _add_args(parser: argparse.ArgumentParser) -> None:
             parser.add_argument(
                 name, type=float, nargs=3, default=list(f.default)
             )
+        elif isinstance(f.default, tuple):
+            # generic variable-length tuple field (e.g. serve buckets)
+            elem = type(f.default[0]) if f.default else str
+            parser.add_argument(
+                name, type=elem, nargs="+", default=list(f.default)
+            )
         elif f.name == "cosine_t_max":
             parser.add_argument(name, type=int, default=None)
         else:
             parser.add_argument(name, type=type(f.default), default=f.default)
 
 
+def _tuplify(cls, d: dict) -> dict:
+    for f in dataclasses.fields(cls):
+        if isinstance(f.default, tuple):
+            d[f.name] = tuple(d[f.name])
+    return d
+
+
 def parse_config(argv=None) -> TrainConfig:
     parser = argparse.ArgumentParser(description="TPU-native CIFAR-10 training")
     _add_args(parser)
     ns = parser.parse_args(argv)
-    d = vars(ns)
-    d["mean"] = tuple(d["mean"])
-    d["std"] = tuple(d["std"])
-    return TrainConfig(**d)
+    return TrainConfig(**_tuplify(TrainConfig, vars(ns)))
+
+
+def parse_serve_config(argv=None) -> ServeConfig:
+    parser = argparse.ArgumentParser(
+        description="Batched inference serving (see SERVING.md)"
+    )
+    _add_args(parser, ServeConfig)
+    ns = parser.parse_args(argv)
+    return ServeConfig(**_tuplify(ServeConfig, vars(ns)))

@@ -33,6 +33,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 spells the unconstrained-HBM memory space ANY; the HBM alias
+# arrived with the MemorySpace rename. One name here, both jax versions.
+_HBM = getattr(pltpu, "HBM", None) or pltpu.ANY
+
 # DMA pipeline depth: enough to cover ~2.6 us completion latency at the
 # observed ~0.1-0.2 us issue rate; deeper rings add no throughput.
 _INFLIGHT = 32
@@ -75,8 +79,13 @@ def _gather_kernel(idx_ref, img_ref, out_ref, sems):
 
     jax.lax.fori_loop(0, block // u, body, 0, unroll=False)
 
+    # block and k are static shape ints: keep the loop bound a Python int
+    # so fori_loop sees static bounds (required for `unroll` on older jax;
+    # a jnp.minimum here would trace to a dynamic bound for no gain)
+    tail = min(block, k)
+
     def drain(t, carry):
-        j = block - jnp.minimum(block, k) + t
+        j = block - tail + t
 
         @pl.when(j < block)
         def _wait_tail():
@@ -84,7 +93,7 @@ def _gather_kernel(idx_ref, img_ref, out_ref, sems):
 
         return carry
 
-    jax.lax.fori_loop(0, jnp.minimum(block, k), drain, 0, unroll=False)
+    jax.lax.fori_loop(0, tail, drain, 0, unroll=False)
 
 
 def rows_dma_tileable(row_shape) -> bool:
@@ -148,9 +157,9 @@ def dma_row_gather(
         grid=grid,
         in_specs=[
             pl.BlockSpec((block,), lambda g: (g,), memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=_HBM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.HBM),
+        out_specs=pl.BlockSpec(memory_space=_HBM),
         out_shape=jax.ShapeDtypeStruct((m,) + flat.shape[1:], images.dtype),
         scratch_shapes=[pltpu.SemaphoreType.DMA((_INFLIGHT,))],
         interpret=interpret,

@@ -21,12 +21,31 @@ without code changes.
 
 from __future__ import annotations
 
+import inspect
 from functools import partial
 from typing import Callable, Optional
 
 import jax
-from jax import shard_map
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax < 0.5 ships it pre-stabilization only
+    from jax.experimental.shard_map import shard_map as _shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+
+    def shard_map(*args, check_vma=None, **kwargs):
+        """Older jax spells ``check_vma`` as ``check_rep`` (the varying-
+        manual-axes rename landed with the jax.shard_map stabilization);
+        translate so every call site can use the current-generation
+        keyword. Single chokepoint — callers (here and in tests) import
+        shard_map from THIS module, never from jax directly."""
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(*args, **kwargs)
 
 from pytorch_cifar_tpu.parallel.mesh import DATA_AXIS
 

@@ -1,0 +1,32 @@
+"""Inference serving: the L6 layer above the training stack.
+
+The reference has no serving story at all — its only inference path is the
+eval loop inside training (main.py:116-133). Here a trained checkpoint
+becomes a long-lived prediction service:
+
+- :class:`~pytorch_cifar_tpu.serve.engine.InferenceEngine` loads any zoo
+  checkpoint (ours via ``train/checkpoint.py``, the reference's ``ckpt.pth``
+  via ``compat.py``) and AOT-compiles one bf16 eval-forward program per
+  batch-size bucket, so no request shape ever triggers a recompile.
+- :class:`~pytorch_cifar_tpu.serve.batcher.MicroBatcher` coalesces
+  concurrent requests into device-sized batches under a latency bound,
+  with bounded-queue admission control and graceful drain.
+- :class:`~pytorch_cifar_tpu.serve.reload.CheckpointWatcher` polls the
+  training run's output dir and atomically swaps new best params into the
+  engine without dropping in-flight requests.
+- :mod:`~pytorch_cifar_tpu.serve.loadgen` is the synthetic closed-loop
+  load generator behind ``serve.py`` and ``bench.py --serve``.
+
+See SERVING.md for the architecture and tuning knobs.
+"""
+
+from pytorch_cifar_tpu.serve.batcher import (  # noqa: F401
+    BatcherClosed,
+    MicroBatcher,
+    QueueFull,
+)
+from pytorch_cifar_tpu.serve.engine import (  # noqa: F401
+    InferenceEngine,
+    load_checkpoint_trees,
+)
+from pytorch_cifar_tpu.serve.reload import CheckpointWatcher  # noqa: F401

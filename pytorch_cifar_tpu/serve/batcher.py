@@ -1,0 +1,207 @@
+"""Dynamic micro-batching: coalesce concurrent requests into device batches.
+
+The engine's per-bucket programs amortize fixed dispatch cost over the
+batch dimension, so serving throughput under concurrency hinges on running
+FEW LARGE batches instead of many single-image ones. The batcher is the
+piece that turns N independent clients into that shape:
+
+- ``submit`` enqueues a request (1..k images) and returns a
+  ``concurrent.futures.Future``; a single worker thread drains the queue.
+- The worker coalesces queued requests up to ``max_batch`` images, waiting
+  at most ``max_wait_ms`` after it picks up the first one — the classic
+  latency/throughput knob (0 = never wait, pure FIFO).
+- **Admission control**: the queue is bounded at ``max_queue`` images.
+  A full queue rejects with :class:`QueueFull` instead of growing without
+  bound — under sustained overload an unbounded queue converts overload
+  into unbounded latency for EVERY request, which is strictly worse than
+  telling some clients to back off (they retry; see loadgen).
+- **Graceful drain**: ``close()`` rejects new submissions immediately,
+  finishes everything already admitted (so accepted requests are never
+  dropped), then stops the worker. ``close(drain=False)`` fails pending
+  requests with :class:`BatcherClosed` for fast teardown.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the request queue is at max_queue images."""
+
+
+class BatcherClosed(RuntimeError):
+    """The batcher is shutting down and accepts no new requests."""
+
+
+class _Pending:
+    __slots__ = ("x", "n", "future")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.n = x.shape[0]
+        self.future: Future = Future()
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch: Optional[int] = None,
+        max_wait_ms: float = 2.0,
+        max_queue: int = 1024,
+        autostart: bool = True,
+    ):
+        self.engine = engine
+        self.max_batch = int(max_batch or max(engine.buckets))
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        if self.max_queue < self.max_batch:
+            # a queue smaller than one batch could never fill a batch
+            raise ValueError("max_queue must be >= max_batch")
+        self._q: deque = deque()
+        self._queued_images = 0
+        self._cond = threading.Condition()
+        self._closed = False
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+        # observability for tests and the CLIs
+        self.stats = {
+            "requests": 0,
+            "images": 0,
+            "batches": 0,
+            "rejected": 0,
+            "largest_batch": 0,
+        }
+        if autostart:
+            self.start()
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, images: np.ndarray) -> Future:
+        """Enqueue a request; the Future resolves to fp32 logits for
+        exactly these rows. Raises QueueFull/BatcherClosed synchronously
+        so the caller can apply backpressure without blocking."""
+        req = _Pending(np.asarray(images))
+        if req.n < 1:
+            raise ValueError("empty request")
+        with self._cond:
+            if self._closed:
+                raise BatcherClosed("batcher is closed")
+            if self._queued_images + req.n > self.max_queue:
+                self.stats["rejected"] += 1
+                raise QueueFull(
+                    f"queue at {self._queued_images}/{self.max_queue} "
+                    f"images; retry later"
+                )
+            self._q.append(req)
+            self._queued_images += req.n
+            self.stats["requests"] += 1
+            self._cond.notify()
+        return req.future
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(images).result()
+
+    # -- worker side ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name="micro-batcher", daemon=True
+            )
+            self._thread.start()
+
+    def _take_batch(self):
+        """Block until work exists, then coalesce up to max_batch images,
+        waiting at most max_wait_ms after the first request is picked up.
+        Returns [] only at shutdown with an empty queue."""
+        with self._cond:
+            while not self._q and not self._closed:
+                self._cond.wait()
+            if not self._q:
+                return []  # closed and fully drained
+            batch = [self._q.popleft()]
+            total = batch[0].n
+            deadline = time.monotonic() + self.max_wait_ms / 1e3
+            while total < self.max_batch:
+                if self._q:
+                    if total + self._q[0].n > self.max_batch:
+                        break  # requests are never split across batches
+                    req = self._q.popleft()
+                    batch.append(req)
+                    total += req.n
+                else:
+                    if self._closed:
+                        break  # draining: don't wait for traffic that
+                        # can no longer arrive
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    if not self._q:
+                        break  # timeout or spurious wake with no work
+            self._queued_images -= total
+            self.stats["batches"] += 1
+            self.stats["images"] += total
+            self.stats["largest_batch"] = max(
+                self.stats["largest_batch"], total
+            )
+        return batch
+
+    def _worker(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return
+            if not self._drain and self._closed:
+                for req in batch:
+                    req.future.set_exception(
+                        BatcherClosed("batcher closed without drain")
+                    )
+                continue
+            x = (
+                batch[0].x
+                if len(batch) == 1
+                else np.concatenate([r.x for r in batch], axis=0)
+            )
+            try:
+                out = self.engine.predict(x)
+            except Exception as e:  # engine failure fails THIS batch only
+                for req in batch:
+                    req.future.set_exception(e)
+                continue
+            off = 0
+            for req in batch:
+                req.future.set_result(out[off : off + req.n])
+                off += req.n
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop accepting requests; by default finish everything already
+        admitted before the worker exits."""
+        with self._cond:
+            self._closed = True
+            self._drain = drain
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

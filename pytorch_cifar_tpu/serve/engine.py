@@ -1,0 +1,349 @@
+"""Inference engine: shape-bucketed AOT-compiled forwards + hot-swap.
+
+Design constraints (SERVING.md has the full rationale):
+
+- **No request may ever trigger a recompile.** A cold XLA compile takes
+  seconds on CPU and minutes on the tunneled TPU — paying it inside a
+  request would blow any latency SLO by 3-5 orders of magnitude. The
+  engine therefore AOT-compiles (``jax.jit(...).lower(...).compile()``)
+  one eval-forward executable per configured batch-size *bucket* at
+  startup and pads every partial batch to the nearest bucket. An AOT
+  executable structurally cannot retrace: a shape outside the compiled
+  set raises instead of silently recompiling, and ``compile_count`` lets
+  tests pin the total.
+- **Padding must not change answers.** Eval-mode forward is per-row
+  independent (BN uses running stats, pooling/conv act per image), so
+  the first ``n`` rows of a padded batch are bit-identical to an
+  unbatched forward of the same rows — pinned by tests/test_serve.py
+  against :meth:`InferenceEngine.direct_forward`.
+- **Weight swaps are atomic and never drop in-flight work.** Params and
+  batch_stats live behind one reference; a swap validates that the new
+  trees have identical avals (same model, same dtypes — so the compiled
+  executables remain valid) and replaces the reference in one assignment.
+  Requests already executing keep the tuple they captured.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+def load_checkpoint_trees(
+    ckpt: str, model_name: str, num_classes: int = 10
+) -> Tuple[Any, Any, dict]:
+    """Load serving weights from any checkpoint we understand.
+
+    ``ckpt`` may be:
+    - a directory written by the Trainer: the BEST-params checkpoint is
+      preferred (``checkpoint.best_checkpoint_order`` — serving wants the
+      best accuracy, not the newest preemption state),
+    - a direct ``.msgpack`` path (ours), or
+    - a reference ``ckpt.pth`` (torch; mapped through ``compat.py`` —
+      requires torch importable, the only path that does).
+
+    Returns ``(params, batch_stats, meta)`` as host numpy trees; ``meta``
+    carries ``epoch``/``best_acc`` when a sidecar (or torch envelope)
+    provides them.
+    """
+    import json
+
+    from pytorch_cifar_tpu.train.checkpoint import (
+        best_checkpoint_order,
+        meta_path,
+    )
+
+    path = ckpt
+    if os.path.isdir(path):
+        for name in best_checkpoint_order(path):
+            p = os.path.join(path, name)
+            if os.path.isfile(p):
+                path = p
+                break
+        else:
+            raise FileNotFoundError(
+                f"no checkpoint in {path!r} "
+                f"(looked for {best_checkpoint_order(path)})"
+            )
+
+    meta: dict = {}
+    if path.endswith(".pth"):
+        try:
+            import torch
+        except ImportError as e:  # pragma: no cover - torch is baked in CI
+            raise RuntimeError(
+                "loading a reference ckpt.pth requires torch; convert it "
+                "once with tools/import_torch_checkpoint.py instead"
+            ) from e
+        from pytorch_cifar_tpu.compat import (
+            import_torch_state_dict,
+            normalize_state_dict,
+        )
+
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+        sd, meta = normalize_state_dict(obj)
+        sd = {
+            k: v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+            for k, v in sd.items()
+        }
+        params, batch_stats, _report = import_torch_state_dict(
+            model_name, sd, num_classes=num_classes
+        )
+        return params, batch_stats, meta
+
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    # the canonical sidecar rule (checkpoint.meta_path): <stem>.json next
+    # to the msgpack
+    sidecar = meta_path(os.path.dirname(path) or ".", os.path.basename(path))
+    try:
+        with open(sidecar) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        meta = {}
+    return tree["params"], tree.get("batch_stats", {}), meta
+
+
+class InferenceEngine:
+    """Batched eval-forward over pre-compiled per-bucket XLA programs.
+
+    ``predict`` accepts uint8 NHWC images ``(n, H, W, C)`` for ANY n >= 1:
+    n is padded up to the nearest bucket (requests larger than the biggest
+    bucket are chunked through it) and fp32 logits for exactly the n input
+    rows come back. Thread-safe: executables are immutable after
+    :meth:`warmup` and the weight reference swap is a single assignment.
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        params,
+        batch_stats,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        compute_dtype=None,
+        num_classes: int = 10,
+        mean: Optional[Sequence[float]] = None,
+        std: Optional[Sequence[float]] = None,
+        image_shape: Tuple[int, int, int] = (32, 32, 3),
+        warmup: bool = True,
+    ):
+        import jax.numpy as jnp
+
+        from pytorch_cifar_tpu.data.augment import (
+            CIFAR10_MEAN,
+            CIFAR10_STD,
+            normalize,
+        )
+        from pytorch_cifar_tpu.models import create_model
+
+        if not buckets:
+            raise ValueError("need at least one batch-size bucket")
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if self.buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {self.buckets}")
+        self.model_name = model_name
+        self.num_classes = num_classes
+        self.image_shape = tuple(image_shape)
+        self.compute_dtype = (
+            jnp.bfloat16 if compute_dtype is None else compute_dtype
+        )
+        mean = CIFAR10_MEAN if mean is None else tuple(mean)
+        std = CIFAR10_STD if std is None else tuple(std)
+        # dtype=None -> fp32 module params/compute (the zoo convention);
+        # bf16 modules match the trainer's amp policy
+        model = create_model(
+            model_name,
+            num_classes=num_classes,
+            dtype=None
+            if self.compute_dtype == jnp.float32
+            else self.compute_dtype,
+        )
+
+        def fwd(params, batch_stats, x):
+            xn = normalize(x, mean, std, dtype=self.compute_dtype)
+            logits = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                xn,
+                train=False,
+            )
+            # fp32 on the wire regardless of compute dtype: clients should
+            # not see bf16 quantization in the response payload
+            return logits.astype(jnp.float32)
+
+        self._fwd = fwd
+        self._compiled: dict = {}  # bucket -> AOT executable
+        self._direct: dict = {}  # exact-shape verification programs
+        self._swap_lock = threading.Lock()
+        self.compile_count = 0  # bucket compiles only (see warmup)
+        self.version = 0  # bumped by every swap_weights
+        self._set_weights(params, batch_stats)
+        if warmup:
+            self.warmup()
+
+    # -- weights -------------------------------------------------------
+
+    def _set_weights(self, params, batch_stats) -> None:
+        import jax
+
+        # one H2D put at swap time, not per request
+        self._weights = jax.device_put((params, batch_stats or {}))
+
+    @staticmethod
+    def _avals(tree):
+        import jax
+
+        return [
+            (jax.tree_util.keystr(p), np.shape(v), np.asarray(v).dtype)
+            for p, v in jax.tree_util.tree_leaves_with_path(tree)
+        ]
+
+    def swap_weights(self, params, batch_stats) -> int:
+        """Atomically replace the served weights; returns the new version.
+
+        The new trees must match the current ones leaf-for-leaf in path,
+        shape, and dtype — that is exactly the condition under which the
+        pre-compiled executables stay valid, so a wrong-model checkpoint
+        fails HERE instead of poisoning the serving path. In-flight
+        requests keep the weight tuple they already captured; nothing is
+        dropped.
+        """
+        old_p, old_s = self._weights
+        for old, new, kind in (
+            (old_p, params, "params"),
+            (old_s, batch_stats or {}, "batch_stats"),
+        ):
+            if self._avals(old) != self._avals(new):
+                raise ValueError(
+                    f"refusing weight swap: new {kind} tree does not match "
+                    f"the compiled program's avals (different model/config?)"
+                )
+        with self._swap_lock:
+            self._set_weights(params, batch_stats)
+            self.version += 1
+        return self.version
+
+    # -- compilation ---------------------------------------------------
+
+    def warmup(self) -> None:
+        """AOT-compile every bucket program (idempotent). After this, no
+        ``predict`` can compile anything: each bucket call goes through
+        its pre-built executable, which raises on any other shape."""
+        import jax
+        import jax.numpy as jnp
+
+        params, stats = self._weights
+        for b in self.buckets:
+            if b in self._compiled:
+                continue
+            x = jnp.zeros((b, *self.image_shape), jnp.uint8)
+            self._compiled[b] = (
+                jax.jit(self._fwd).lower(params, stats, x).compile()
+            )
+            self.compile_count += 1
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n, or the largest bucket (callers chunk)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    # -- inference -----------------------------------------------------
+
+    def _run_bucket(self, x: np.ndarray) -> np.ndarray:
+        """One padded executable call: len(x) <= max bucket."""
+        n = x.shape[0]
+        b = self.bucket_for(n)
+        if n < b:
+            pad = np.zeros((b - n, *self.image_shape), x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        params, stats = self._weights  # atomic tuple read
+        out = self._compiled[b](params, stats, x)
+        return np.asarray(out)[:n]
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """uint8 NHWC batch of any size -> fp32 logits ``(n, classes)``."""
+        x = np.asarray(images)
+        if x.ndim != 4 or x.shape[1:] != self.image_shape:
+            raise ValueError(
+                f"expected (n, {', '.join(map(str, self.image_shape))}) "
+                f"images, got {x.shape}"
+            )
+        if not self._compiled:
+            raise RuntimeError("engine not warmed up — call warmup() first")
+        n, cap = x.shape[0], self.buckets[-1]
+        if n <= cap:
+            return self._run_bucket(x)
+        return np.concatenate(
+            [self._run_bucket(x[i : i + cap]) for i in range(0, n, cap)]
+        )
+
+    def direct_forward(self, images: np.ndarray) -> np.ndarray:
+        """Unbatched/unpadded jitted forward at the EXACT request shape —
+        the bit-identity oracle for tests and ``serve.py --verify``. Its
+        compiles are deliberately not counted in ``compile_count`` (they
+        are verification overhead, not the serving path)."""
+        import jax
+
+        x = np.asarray(images)
+        n = x.shape[0]
+        if n not in self._direct:
+            params, stats = self._weights
+            self._direct[n] = (
+                jax.jit(self._fwd)
+                .lower(params, stats, jax.numpy.asarray(x))
+                .compile()
+            )
+        params, stats = self._weights
+        return np.asarray(self._direct[n](params, stats, x))
+
+    # -- constructors --------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(
+        cls, ckpt: str, model_name: str, *, num_classes: int = 10, **kw
+    ) -> "InferenceEngine":
+        """Build from a Trainer output dir / .msgpack / reference .pth."""
+        params, stats, meta = load_checkpoint_trees(
+            ckpt, model_name, num_classes=num_classes
+        )
+        eng = cls(
+            model_name, params, stats, num_classes=num_classes, **kw
+        )
+        eng.checkpoint_meta = meta
+        return eng
+
+    @classmethod
+    def from_random(
+        cls, model_name: str, *, seed: int = 0, num_classes: int = 10, **kw
+    ) -> "InferenceEngine":
+        """Fresh-init weights (bench/loadgen: serving throughput does not
+        depend on the parameter values, only the program)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_cifar_tpu.models import create_model
+
+        model = create_model(model_name, num_classes=num_classes)
+        variables = model.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, 32, 32, 3), jnp.float32),
+            train=False,
+        )
+        eng = cls(
+            model_name,
+            dict(variables["params"]),
+            dict(variables.get("batch_stats", {})),
+            num_classes=num_classes,
+            **kw,
+        )
+        eng.checkpoint_meta = {}
+        return eng

@@ -1,0 +1,114 @@
+"""Synthetic closed-loop load generator + latency statistics.
+
+Closed-loop: each simulated client submits one request, BLOCKS on its
+result, then immediately submits the next — so offered load adapts to
+service capacity (``clients`` bounds the in-flight requests) and the
+latency distribution is the one a real synchronous client would see.
+``QueueFull`` rejections are counted and retried after a short backoff,
+exercising the admission-control path rather than hiding it.
+
+Shared by ``serve.py`` and ``bench.py --serve`` so the reported p50/p95/p99
+and img/s always mean the same protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from pytorch_cifar_tpu.serve.batcher import QueueFull
+
+
+def percentile_ms(latencies_ms, pct: float) -> float:
+    """Nearest-rank percentile of a latency sample (ms)."""
+    if not latencies_ms:
+        return 0.0
+    xs = sorted(latencies_ms)
+    idx = min(len(xs) - 1, max(0, int(round(pct / 100.0 * len(xs))) - 1))
+    return xs[idx]
+
+
+def run_load(
+    batcher,
+    *,
+    clients: int = 8,
+    requests_per_client: int = 16,
+    images_min: int = 1,
+    images_max: int = 8,
+    image_shape=(32, 32, 3),
+    seed: int = 0,
+    retry_backoff_s: float = 0.002,
+    duration_s: Optional[float] = None,
+) -> dict:
+    """Drive ``batcher`` with ``clients`` synchronous synthetic clients.
+
+    Each request carries a uniform-random 1..images_max image batch (the
+    realistic serving mix: mostly small requests, padded by the engine).
+    Stops after ``requests_per_client`` requests per client, or after
+    ``duration_s`` wall seconds when given (whichever comes first).
+
+    Returns the latency/throughput report the CLIs publish:
+    ``img_per_sec``, ``request_per_sec``, ``p50_ms``/``p95_ms``/``p99_ms``,
+    ``mean_ms``, ``requests``, ``images``, ``rejected``, ``elapsed_s``.
+    """
+    images_max = max(images_min, images_max)
+    latencies_ms: list = []
+    counts = {"images": 0, "rejected": 0}
+    lock = threading.Lock()
+    stop_at = None
+
+    def client(cid: int) -> None:
+        rs = np.random.RandomState(seed * 1000 + cid)
+        for _ in range(requests_per_client):
+            if stop_at is not None and time.monotonic() >= stop_at:
+                return
+            n = int(rs.randint(images_min, images_max + 1))
+            x = rs.randint(0, 256, size=(n, *image_shape)).astype(np.uint8)
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    fut = batcher.submit(x)
+                    break
+                except QueueFull:
+                    # admission control said back off; the retry delay is
+                    # part of the client-observed latency (t0 stays)
+                    with lock:
+                        counts["rejected"] += 1
+                    time.sleep(retry_backoff_s)
+            fut.result()
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                latencies_ms.append(dt_ms)
+                counts["images"] += n
+
+    threads = [
+        threading.Thread(target=client, args=(i,), name=f"loadgen-{i}")
+        for i in range(clients)
+    ]
+    t_start = time.perf_counter()
+    if duration_s is not None:
+        stop_at = time.monotonic() + duration_s
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+
+    return {
+        "clients": clients,
+        "requests": len(latencies_ms),
+        "images": counts["images"],
+        "rejected": counts["rejected"],
+        "elapsed_s": round(elapsed, 4),
+        "img_per_sec": counts["images"] / max(elapsed, 1e-9),
+        "request_per_sec": len(latencies_ms) / max(elapsed, 1e-9),
+        "mean_ms": (
+            sum(latencies_ms) / len(latencies_ms) if latencies_ms else 0.0
+        ),
+        "p50_ms": percentile_ms(latencies_ms, 50),
+        "p95_ms": percentile_ms(latencies_ms, 95),
+        "p99_ms": percentile_ms(latencies_ms, 99),
+    }

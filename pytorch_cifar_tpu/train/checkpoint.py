@@ -88,6 +88,16 @@ def newest_checkpoint_order(output_dir: str):
     return [CKPT_NAME, LAST_NAME]
 
 
+def best_checkpoint_order(output_dir: str = None):
+    """Checkpoint preference when the caller wants the BEST params (eval
+    and serving, not training resume): the best-accuracy ckpt first, the
+    preemption save only as a fallback for runs that never improved past
+    epoch 0. Shared by Trainer (--evaluate) and serve/ so the rule cannot
+    drift. ``output_dir`` is accepted for signature symmetry with
+    :func:`newest_checkpoint_order`; the best-first order is static."""
+    return [CKPT_NAME, LAST_NAME]
+
+
 def remove_stale_last(output_dir: str) -> None:
     """Delete the preemption save (last.msgpack + sidecar) after a run
     COMPLETES normally: a leftover one would make a routine relaunch with

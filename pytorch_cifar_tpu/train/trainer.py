@@ -50,6 +50,7 @@ from pytorch_cifar_tpu.parallel.mesh import is_primary
 from pytorch_cifar_tpu.train.checkpoint import (
     CKPT_NAME,
     LAST_NAME,
+    best_checkpoint_order,
     meta_path,
     remove_stale_last,
     restore_checkpoint,
@@ -259,7 +260,7 @@ class Trainer:
             # training back or clobber the true best via its old best_acc.
             # Eval-only always wants the best-accuracy params.
             names = (
-                [CKPT_NAME, LAST_NAME]
+                best_checkpoint_order(config.output_dir)
                 if config.evaluate
                 else self._resume_order(config.output_dir)
             )

@@ -1,0 +1,154 @@
+"""Batched inference serving CLI: load a checkpoint, answer requests.
+
+The production-shaped entry point for the serving subsystem
+(``pytorch_cifar_tpu/serve/``; SERVING.md documents the architecture):
+
+- loads the BEST-params checkpoint from ``--ckpt`` (a Trainer output dir,
+  a direct ``.msgpack``, or a reference ``ckpt.pth`` via compat),
+- AOT-compiles one eval-forward program per ``--buckets`` batch size, so
+  no request ever compiles after warmup,
+- coalesces concurrent requests in a bounded-queue micro-batcher, and
+- (``--watch``) hot-reloads newer best checkpoints from the same dir
+  without dropping in-flight requests — point it at the output_dir of a
+  RUNNING train.py and it tracks the best params as they improve.
+
+There is no HTTP frontend yet (ROADMAP open item); the built-in
+synthetic closed-loop load generator stands in for the network clients
+and doubles as the latency benchmark:
+
+    python serve.py --ckpt ./checkpoint --model ResNet18
+    python serve.py --ckpt ./checkpoint --model ResNet18 --watch \
+        --clients 16 --requests 256 --max_wait_ms 5
+
+Prints ONE JSON line on stdout with img/s and p50/p95/p99 latency
+(progress and engine info go to stderr); ``--verify`` additionally
+asserts the padded bucket path is bit-identical to a direct unpadded
+jitted forward before any load runs.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
+    from pytorch_cifar_tpu.config import parse_serve_config
+
+    honor_platform_env()
+    enable_compilation_cache()
+    cfg = parse_serve_config()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import (
+        CheckpointWatcher,
+        InferenceEngine,
+        MicroBatcher,
+    )
+    from pytorch_cifar_tpu.serve.loadgen import run_load
+
+    platform = jax.devices()[0].platform
+    compute_dtype = (
+        jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    )
+
+    print(
+        f"==> loading {cfg.model} from {cfg.ckpt} "
+        f"(buckets {tuple(cfg.buckets)}, {cfg.dtype}, {platform})",
+        file=sys.stderr,
+    )
+    engine = InferenceEngine.from_checkpoint(
+        cfg.ckpt,
+        cfg.model,
+        num_classes=cfg.num_classes,
+        buckets=cfg.buckets,
+        compute_dtype=compute_dtype,
+        mean=cfg.mean,
+        std=cfg.std,
+    )
+    print(
+        f"==> warm: {engine.compile_count} bucket programs compiled, "
+        f"checkpoint meta {engine.checkpoint_meta}",
+        file=sys.stderr,
+    )
+
+    if cfg.verify:
+        rs = np.random.RandomState(cfg.seed)
+        # an off-bucket size, so the padded path is actually exercised
+        n = max(cfg.buckets[0] + 1, 3) if len(cfg.buckets) > 1 else 1
+        x = rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+        padded, direct = engine.predict(x), engine.direct_forward(x)
+        if not np.array_equal(padded, direct):
+            print(
+                "error: padded bucket forward is not bit-identical to the "
+                "direct unpadded forward",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"==> verify: bucket-padded forward bit-identical to direct "
+            f"forward at n={n}",
+            file=sys.stderr,
+        )
+
+    batcher = MicroBatcher(
+        engine,
+        max_batch=cfg.max_batch or None,
+        max_wait_ms=cfg.max_wait_ms,
+        max_queue=cfg.max_queue,
+    )
+    watcher = None
+    if cfg.watch:
+        watcher = CheckpointWatcher(
+            engine, cfg.ckpt, poll_s=cfg.poll_s
+        ).start()
+        print(
+            f"==> watching {cfg.ckpt} for new best checkpoints "
+            f"(poll {cfg.poll_s}s)",
+            file=sys.stderr,
+        )
+
+    try:
+        report = run_load(
+            batcher,
+            clients=cfg.clients,
+            requests_per_client=cfg.requests,
+            images_max=cfg.request_images_max,
+            seed=cfg.seed,
+            duration_s=cfg.duration_s or None,
+        )
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        batcher.close()  # graceful drain
+
+    compiles_after = engine.compile_count
+    out = {
+        "model": cfg.model,
+        "ckpt": cfg.ckpt,
+        "platform": platform,
+        "dtype": cfg.dtype,
+        "buckets": list(engine.buckets),
+        "max_batch": batcher.max_batch,
+        "max_wait_ms": cfg.max_wait_ms,
+        "compiles": compiles_after,
+        "engine_version": engine.version,
+        "reloads": watcher.reloads if watcher is not None else 0,
+        "batches": batcher.stats["batches"],
+        "largest_batch": batcher.stats["largest_batch"],
+        **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in report.items()
+        },
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,11 @@ This is the distributed-testing strategy the reference could not have
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# no jax import yet: pytorch_cifar_tpu/__init__.py only touches jax inside
+# its helper functions, so the flag probe below runs before any backend init
+from pytorch_cifar_tpu import xla_collective_timeout_flags  # noqa: E402
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -18,10 +23,12 @@ if "collective_call_terminate" not in flags:
     # rendezvous termination then abort()s the whole process (observed:
     # "Fatal Python error: Aborted" mid-suite). These are liveness
     # timeouts, not correctness ones — raise them far past any real test.
-    flags += (
-        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=300"
-    )
+    # Gated on jaxlib support: an UNKNOWN flag in XLA_FLAGS also aborts
+    # the process (parse_flags_from_env.cc), which on jaxlib 0.4.36 took
+    # down every test before collection even finished.
+    timeout_flags = xla_collective_timeout_flags()
+    if timeout_flags:
+        flags += " " + timeout_flags
 os.environ["XLA_FLAGS"] = flags
 
 # A site-installed TPU plugin may override jax_platforms in jax.config at
@@ -76,6 +83,12 @@ SLOW_TESTS = {
     "test_ops.py": (
         "test_conv_bn_relu_matches_lax",
         "test_conv_bn_relu_bf16_io",
+    ),
+    # serve unit tests are tier-1 fast; the subprocess CLI drive and the
+    # ResNet18 flagship path are integration-weight (big CPU compiles)
+    "test_serve.py": (
+        "test_serve_cli_end_to_end",
+        "test_resnet18_checkpoint_serving_bit_identical",
     ),
 }
 

@@ -145,3 +145,44 @@ def test_bench_epoch_mode_prints_one_json_line():
     assert rec["metric"].startswith("epoch_throughput_LeNet_b128")
     assert rec["metric"].endswith("_cpu")
     assert rec["value"] > 0
+
+
+def test_bench_serve_mode_prints_one_json_line():
+    """--serve (round 6): closed-loop serving latency through the bucket-
+    compiled engine + micro-batcher; the single JSON line carries the
+    driver contract keys PLUS the latency SLO percentiles."""
+    rec, _ = run_bench(
+        ["--model", "LeNet", "--serve", "--steps", "2", "--batch", "16"]
+    )
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"].startswith("serve_throughput_LeNet_b16"), rec
+    assert rec["metric"].endswith("_cpu"), rec["metric"]
+    assert rec["value"] > 0
+    assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+    assert rec["p95_ms"] >= rec["p50_ms"]
+    assert rec["rejected"] >= 0 and rec["requests"] > 0
+
+
+def test_parse_child_record_skips_non_record_json_lines():
+    """headline()'s child-stdout parsing (ADVICE round 5): stray brace-
+    prefixed lines — dependency JSON warnings, malformed braces — must
+    be skipped, and only a dict carrying the contract keys ('metric',
+    'value') is accepted; the LAST such record wins."""
+    import bench
+
+    good = {"metric": "m", "value": 1.5, "unit": "u"}
+    newer = {"metric": "m2", "value": 2.5}
+    stdout = "\n".join(
+        [
+            "log line",
+            '{"warning": "dependency json on stdout"}',  # no contract keys
+            "{not json at all",
+            json.dumps(good),
+            '{"also": "noise"}',
+            json.dumps(newer),  # last valid record wins
+            "{",
+        ]
+    )
+    assert bench.parse_child_record(stdout) == newer
+    assert bench.parse_child_record("no json here\n{broken\n") is None
+    assert bench.parse_child_record("") is None

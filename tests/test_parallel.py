@@ -147,10 +147,10 @@ def test_augmentation_decorrelated_across_shards():
     missing set_epoch, SURVEY.md §3.2)."""
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from pytorch_cifar_tpu.data.augment import augment_batch
+    from pytorch_cifar_tpu.parallel.dp import shard_map  # version shim
 
     mesh = make_mesh()
 

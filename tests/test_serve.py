@@ -1,0 +1,414 @@
+"""Serving subsystem tests (CPU, fast, no network — tier-1).
+
+The contracts pinned here are the ones SERVING.md promises:
+- bucket padding is BIT-identical to a direct unpadded jitted forward,
+- nothing compiles after warmup (compile_count is exact),
+- concurrent requests coalesce into few device batches,
+- a full queue rejects (admission control) instead of growing,
+- a checkpoint hot-reload swaps params atomically mid-stream, and
+- graceful drain answers every admitted request.
+
+The end-to-end serve.py CLI drive is marked slow (conftest) like the
+other subprocess CLI tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _images(n, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def lenet_engine():
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    return InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4, 8), compute_dtype=jnp.float32
+    )
+
+
+# -- engine: buckets, padding, compile accounting -----------------------
+
+
+def test_bucket_padding_bit_identical_to_direct_forward(lenet_engine):
+    """Every request size pads up to a bucket (odd sizes exercise real
+    padding) yet returns logits BIT-identical to an unpadded jitted
+    forward of the same rows — padding must never change answers."""
+    eng = lenet_engine
+    for n in (1, 2, 3, 4, 5, 7, 8):
+        x = _images(n, seed=n)
+        got = eng.predict(x)
+        want = eng.direct_forward(x)
+        assert got.shape == (n, 10) and got.dtype == np.float32
+        assert np.array_equal(got, want), f"n={n} diverged"
+
+
+def test_bucket_padding_bit_identical_bf16():
+    """Same bit-identity under the default bf16 serving dtype (the
+    compute dtype is identical on both paths, so exact equality holds)."""
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    eng = InferenceEngine.from_random("LeNet", buckets=(1, 8))
+    x = _images(5, seed=42)
+    assert np.array_equal(eng.predict(x), eng.direct_forward(x))
+
+
+def test_no_recompile_after_warmup(lenet_engine):
+    """The compile-count pin: warmup compiles exactly one program per
+    bucket, and NO predict — any size, including chunked oversize
+    requests — adds another. AOT executables raise on a foreign shape,
+    so a silent fallback retrace is structurally impossible."""
+    eng = lenet_engine
+    assert eng.compile_count == len(eng.buckets) == 3
+    for n in (1, 2, 3, 5, 8, 9, 17, 30):
+        out = eng.predict(_images(n, seed=n))
+        assert out.shape == (n, 10)
+    assert eng.compile_count == 3
+
+
+def test_oversize_request_chunks_match_single_pass(lenet_engine):
+    """Requests beyond the largest bucket chunk through it; rows must
+    equal the per-chunk forwards exactly (same executable, same rows)."""
+    eng = lenet_engine
+    x = _images(19, seed=3)
+    got = eng.predict(x)
+    want = np.concatenate(
+        [eng.predict(x[i : i + 8]) for i in range(0, 19, 8)]
+    )
+    assert np.array_equal(got, want)
+
+
+def test_engine_input_validation(lenet_engine):
+    with pytest.raises(ValueError):
+        lenet_engine.predict(_images(2)[:, :16])  # wrong spatial shape
+
+
+# -- micro-batcher ------------------------------------------------------
+
+
+def test_concurrent_requests_coalesce_into_one_batch(lenet_engine):
+    """6 queued single-image requests start the worker as ONE coalesced
+    6-image batch (max_batch 8): the whole point of the batcher.
+    autostart=False makes the coalescing deterministic — everything is
+    queued before the worker wakes."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=8, max_wait_ms=50, max_queue=64,
+        autostart=False,
+    )
+    xs = [_images(1, seed=i) for i in range(6)]
+    futs = [b.submit(x) for x in xs]
+    b.start()
+    outs = [f.result(timeout=60) for f in futs]
+    b.close()
+    assert b.stats["batches"] == 1
+    assert b.stats["largest_batch"] == 6
+    # coalescing must not permute or corrupt per-request rows: each
+    # answer is bit-identical to its rows in the direct forward of the
+    # coalesced batch. (Comparing to each request's SOLO forward would
+    # additionally pin XLA's gemm reduction strategy across different
+    # batch extents — a non-guarantee: padding preserves the batch extent
+    # the program was compiled for, coalescing legitimately changes it.)
+    full = lenet_engine.direct_forward(np.concatenate(xs, axis=0))
+    for i, out in enumerate(outs):
+        assert np.array_equal(out, full[i : i + 1])
+
+
+def test_batches_split_at_max_batch_and_never_split_requests(lenet_engine):
+    """10 single-image requests against max_batch=4 -> 3 batches; a
+    3-image request that doesn't fit the current batch starts the next
+    one (requests are never split across batches)."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=50, max_queue=64,
+        autostart=False,
+    )
+    futs = [b.submit(_images(1, seed=i)) for i in range(10)]
+    b.start()
+    for f in futs:
+        f.result(timeout=60)
+    b.close()
+    assert b.stats["batches"] == 3
+    b2 = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=50, max_queue=64,
+        autostart=False,
+    )
+    f1 = b2.submit(_images(2, seed=0))
+    f2 = b2.submit(_images(3, seed=1))  # 2+3 > 4: must go to batch 2
+    b2.start()
+    r1, r2 = f1.result(timeout=60), f2.result(timeout=60)
+    b2.close()
+    assert b2.stats["batches"] == 2
+    assert r1.shape == (2, 10) and r2.shape == (3, 10)
+
+
+def test_backpressure_rejects_when_queue_full(lenet_engine):
+    """Admission control: max_queue images queued -> QueueFull (counted),
+    nothing dropped; once the worker drains, capacity returns."""
+    from pytorch_cifar_tpu.serve import MicroBatcher, QueueFull
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=0, max_queue=4,
+        autostart=False,
+    )
+    futs = [b.submit(_images(1, seed=i)) for i in range(4)]
+    with pytest.raises(QueueFull):
+        b.submit(_images(1))
+    assert b.stats["rejected"] == 1
+    b.start()
+    for f in futs:
+        f.result(timeout=60)
+    # drained: admission is open again
+    assert b.submit(_images(1)).result(timeout=60).shape == (1, 10)
+    b.close()
+
+
+def test_close_drains_admitted_requests_then_rejects(lenet_engine):
+    """Graceful shutdown: close() answers every admitted request before
+    the worker exits, and everything after close is BatcherClosed."""
+    from pytorch_cifar_tpu.serve import BatcherClosed, MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=0, max_queue=64,
+        autostart=False,
+    )
+    futs = [b.submit(_images(1, seed=i)) for i in range(9)]
+    b.start()
+    b.close()  # drain=True default
+    for f in futs:
+        assert f.result(timeout=60).shape == (1, 10)
+    with pytest.raises(BatcherClosed):
+        b.submit(_images(1))
+
+
+# -- checkpoint loading + hot reload ------------------------------------
+
+
+def _save_lenet_checkpoint(out_dir, seed, epoch, best_acc):
+    import jax
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2)
+    state = create_train_state(model, jax.random.PRNGKey(seed), tx)
+    save_checkpoint(str(out_dir), state, epoch=epoch, best_acc=best_acc)
+    return state
+
+
+def test_loader_prefers_best_checkpoint(tmp_path):
+    """A serving dir holding both the best ckpt and a newer preemption
+    save loads the BEST params (serving wants accuracy, not recency —
+    the opposite preference from training resume)."""
+    import jax
+
+    from pytorch_cifar_tpu.serve.engine import load_checkpoint_trees
+    from pytorch_cifar_tpu.train.checkpoint import (
+        LAST_NAME,
+        save_checkpoint,
+    )
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    best = _save_lenet_checkpoint(tmp_path, seed=0, epoch=5, best_acc=70.0)
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2)
+    newer = create_train_state(model, jax.random.PRNGKey(9), tx)
+    save_checkpoint(
+        str(tmp_path), newer, epoch=8, best_acc=70.0, name=LAST_NAME
+    )
+    params, _stats, meta = load_checkpoint_trees(str(tmp_path), "LeNet")
+    assert meta["epoch"] == 5 and meta["best_acc"] == 70.0
+    want = jax.tree_util.tree_leaves(jax.device_get(best.params))
+    got = jax.tree_util.tree_leaves(params)
+    assert len(want) == len(got)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_hot_reload_swaps_mid_stream(tmp_path):
+    """The watcher swaps a newer best checkpoint into the engine while a
+    client thread hammers predict: no request fails, the engine version
+    bumps exactly once, and post-swap outputs match the NEW weights'
+    direct forward. poll_once() drives the swap deterministically."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import CheckpointWatcher, InferenceEngine
+
+    _save_lenet_checkpoint(tmp_path, seed=0, epoch=1, best_acc=10.0)
+    eng = InferenceEngine.from_checkpoint(
+        str(tmp_path), "LeNet", buckets=(1, 4), compute_dtype=jnp.float32
+    )
+    watcher = CheckpointWatcher(eng, str(tmp_path), poll_s=3600)
+    x = _images(3, seed=1)
+    before = eng.predict(x)
+
+    errors, stop = [], threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                out = eng.predict(x)
+                assert out.shape == (3, 10)
+            except Exception as e:  # pragma: no cover - failure evidence
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        _save_lenet_checkpoint(tmp_path, seed=7, epoch=2, best_acc=20.0)
+        assert watcher.poll_once() is True
+        after = eng.predict(x)
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+    assert eng.version == 1 and watcher.reloads == 1
+    assert watcher.last_meta["epoch"] == 2
+    assert not np.array_equal(before, after)  # new weights actually serve
+    assert np.array_equal(after, eng.direct_forward(x))
+    # unchanged file -> no spurious reload
+    assert watcher.poll_once() is False and eng.version == 1
+
+
+def test_swap_rejects_mismatched_weights(tmp_path):
+    """A wrong-model checkpoint landing in the watched dir must fail the
+    swap loudly and leave the engine serving its current weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    eng = InferenceEngine.from_random(
+        "LeNet", buckets=(1,), compute_dtype=jnp.float32
+    )
+    wrong = create_model("LeNet", num_classes=7)
+    variables = wrong.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3), jnp.float32),
+        train=False,
+    )
+    with pytest.raises(ValueError, match="refusing weight swap"):
+        eng.swap_weights(dict(variables["params"]), {})
+    x = _images(1)
+    assert eng.predict(x).shape == (1, 10)  # still serving
+
+
+# -- config + load generator --------------------------------------------
+
+
+def test_parse_serve_config_buckets_and_defaults():
+    from pytorch_cifar_tpu.config import parse_serve_config
+
+    cfg = parse_serve_config(
+        ["--model", "LeNet", "--buckets", "1", "4", "--max_wait_ms", "5"]
+    )
+    assert cfg.buckets == (1, 4)
+    assert cfg.max_wait_ms == 5.0
+    assert parse_serve_config([]).buckets == (1, 8, 32, 128)
+
+
+def test_loadgen_reports_latency_percentiles(lenet_engine):
+    from pytorch_cifar_tpu.serve import MicroBatcher
+    from pytorch_cifar_tpu.serve.loadgen import percentile_ms, run_load
+
+    with MicroBatcher(
+        lenet_engine, max_batch=8, max_wait_ms=1, max_queue=64
+    ) as b:
+        rep = run_load(
+            b, clients=3, requests_per_client=3, images_max=4, seed=0
+        )
+    assert rep["requests"] == 9
+    assert rep["images"] >= 9 and rep["img_per_sec"] > 0
+    assert 0 < rep["p50_ms"] <= rep["p95_ms"] <= rep["p99_ms"]
+    assert percentile_ms([1.0, 2.0, 3.0, 4.0], 50) == 2.0
+    assert percentile_ms([], 99) == 0.0
+
+
+def test_resnet18_checkpoint_serving_bit_identical(tmp_path):
+    """The flagship acceptance path (slow: ResNet18 CPU compiles): an
+    engine serving a ResNet18 checkpoint answers padded/coalesced
+    requests bit-identical to the direct unpadded jitted forward, with
+    exactly one compile per bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.serve import InferenceEngine, MicroBatcher
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    model = create_model("ResNet18")
+    tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    save_checkpoint(str(tmp_path), state, epoch=1, best_acc=10.0)
+    eng = InferenceEngine.from_checkpoint(
+        str(tmp_path), "ResNet18", buckets=(1, 4),
+        compute_dtype=jnp.bfloat16,
+    )
+    assert eng.compile_count == 2
+    for n in (1, 3, 4):
+        x = _images(n, seed=n)
+        assert np.array_equal(eng.predict(x), eng.direct_forward(x))
+    with MicroBatcher(eng, max_batch=4, max_wait_ms=20) as b:
+        futs = [b.submit(_images(1, seed=i)) for i in range(4)]
+        for f in futs:
+            assert f.result(timeout=120).shape == (1, 10)
+    assert eng.compile_count == 2  # nothing compiled after warmup
+
+
+# -- serve.py CLI (subprocess; slow like the other CLI drives) ----------
+
+
+def test_serve_cli_end_to_end(tmp_path):
+    """python serve.py --ckpt <dir> --model LeNet answers concurrent
+    synthetic requests with verified bit-identity (--verify), hot-reload
+    armed (--watch), and prints ONE JSON line on stdout."""
+    _save_lenet_checkpoint(tmp_path, seed=0, epoch=4, best_acc=55.0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--ckpt", str(tmp_path), "--model", "LeNet",
+            "--buckets", "1", "4", "8",
+            "--clients", "4", "--requests", "4",
+            "--verify", "--watch", "--poll_s", "0.2",
+        ],
+        capture_output=True, text=True, timeout=560, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    lines = [
+        ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")
+    ]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["model"] == "LeNet"
+    assert rec["compiles"] == 3  # one per bucket, nothing after warmup
+    assert rec["requests"] == 16 and rec["rejected"] == 0
+    assert rec["img_per_sec"] > 0
+    assert 0 < rec["p50_ms"] <= rec["p99_ms"]
+    assert "bit-identical" in r.stderr

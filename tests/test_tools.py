@@ -199,6 +199,75 @@ def test_export_reference_factory_expr_covers_registry(monkeypatch):
     )
 
 
+def test_export_builds_reference_model_without_eval(monkeypatch):
+    """The registry path resolves factories via getattr + the explicit
+    args/kwargs table — never eval (ADVICE round 5: --ref points at code
+    that is imported and executed; expression evaluation on top of that
+    stays behind the --ref_expr escape hatch)."""
+    import types
+
+    monkeypatch.syspath_prepend(os.path.join(REPO, "tools"))
+    import pytest
+    from export_torch_checkpoint import build_reference_model
+
+    calls = []
+    ns = types.SimpleNamespace(
+        ResNet18=lambda: calls.append("r18") or "net18",
+        VGG=lambda name: ("vgg", name),
+        ShuffleNetV2=lambda net_size: ("sn2", net_size),
+    )
+    assert build_reference_model(ns, "ResNet18") == "net18"
+    assert build_reference_model(ns, "VGG16") == ("vgg", "VGG16")
+    assert build_reference_model(ns, "ShuffleNetV2_0.5") == ("sn2", 0.5)
+    # a name the namespace lacks fails loudly, pointing at --ref_expr
+    with pytest.raises(SystemExit, match="ref_expr"):
+        build_reference_model(ns, "DenseNetCifar")
+
+
+def test_export_warns_on_missing_sidecar(tmp_path):
+    """A direct .msgpack whose JSON sidecar is absent/corrupt must warn on
+    stderr that acc/epoch fall back to 0.0/0 (ADVICE round 5: a silent
+    default makes a reference-side --resume restart LR/epoch bookkeeping
+    with no notice). Exercised without a reference checkout: the sidecar
+    read happens before the --ref validation, whose error exits 1."""
+    import jax
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2)
+    state = create_train_state(model, jax.random.PRNGKey(0), tx)
+    save_checkpoint(str(tmp_path), state, epoch=3, best_acc=50.0)
+    os.remove(tmp_path / "ckpt.json")  # orphan the msgpack
+
+    r = _run_tool(
+        [
+            os.path.join(REPO, "tools", "export_torch_checkpoint.py"),
+            "--ckpt", str(tmp_path / "ckpt.msgpack"),
+            "--model", "LeNet", "--out", str(tmp_path / "out.pth"),
+            "--ref", str(tmp_path / "no_such_checkout"),
+        ],
+        expected_returncode=1,
+    )
+    assert "warning: cannot read checkpoint sidecar" in r.stderr
+    assert "0.0/0" in r.stderr
+    # an explicit --acc AND --epoch silence the warning (nothing falls back)
+    r2 = _run_tool(
+        [
+            os.path.join(REPO, "tools", "export_torch_checkpoint.py"),
+            "--ckpt", str(tmp_path / "ckpt.msgpack"),
+            "--model", "LeNet", "--out", str(tmp_path / "out.pth"),
+            "--ref", str(tmp_path / "no_such_checkout"),
+            "--acc", "12.5", "--epoch", "4",
+        ],
+        expected_returncode=1,
+    )
+    assert "warning: cannot read checkpoint sidecar" not in r2.stderr
+
+
 def test_zoo_bench_smoke(tmp_path):
     """zoo_bench end-to-end on CPU: clamps, benches, writes the JSON
     artifact this repo's family table is built from."""

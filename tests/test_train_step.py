@@ -78,8 +78,12 @@ def test_eval_deterministic():
 
 def test_remat_matches_plain_step():
     """jax.checkpoint rematerialization must not change the math: one step
-    with remat on/off from identical state produces identical params (same
-    ops, only the backward's memory/recompute schedule differs)."""
+    with remat on/off from identical state produces the same params up to
+    float32 ULP noise. Not pinned bit-exact: XLA fuses the recomputed
+    backward subgraph differently from the saved-activation one, and some
+    XLA versions reassociate a reduction in the process (observed on
+    XLA:CPU at jaxlib 0.4.36: max |d| 8e-9 on 1e-3-scale params — ULP
+    scale, not a semantic divergence)."""
     model = create_model("ResNet18")
     tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=4)
     rs = np.random.RandomState(0)
@@ -97,7 +101,9 @@ def test_remat_matches_plain_step():
         results.append(
             (float(metrics["loss_sum"]), jax.device_get(state.params))
         )
-    assert results[0][0] == results[1][0]
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
     jax.tree_util.tree_map(
-        np.testing.assert_array_equal, results[0][1], results[1][1]
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-4),
+        results[0][1],
+        results[1][1],
     )
