@@ -405,14 +405,14 @@ def prior_round_value(metric: str):
     return best[1] if best else None
 
 
-def core_record(metric: str, value: float) -> dict:
+def core_record(metric: str, value: float, unit: str = "images/sec/chip") -> dict:
     """The driver-parsed record shape, shared by headline() and main() so
     the contract cannot drift between the two emitters."""
     prior = prior_round_value(metric)
     return {
         "metric": metric,
         "value": round(value, 2),
-        "unit": "images/sec/chip",
+        "unit": unit,
         "vs_baseline": round(value / prior, 4) if prior else 1.0,
     }
 
@@ -435,6 +435,64 @@ def parse_child_record(stdout: str):
         if isinstance(cand, dict) and "metric" in cand and "value" in cand:
             rec = cand
     return rec
+
+
+def chaos_smoke(args) -> int:
+    """One kill-mid-epoch -> resume cycle through tools/chaos_run.py; the
+    headline number is RECOVERY TIME (seconds from relaunch to completed
+    run). Like headline(), this parent never initializes a jax backend —
+    the chaos children own the device. The chaos verdict (`match`) rides
+    along; a failed recovery exits non-zero instead of publishing a
+    number for a broken run."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [
+        sys.executable, os.path.join(here, "tools", "chaos_run.py"),
+        "--mode", "sigterm",
+        "--model", args.model,
+        "--epochs", "3",
+        "--train-size", "512",
+        "--batch", "128",
+    ]
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1800
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("error: chaos smoke timed out\n")
+        raise SystemExit(1)
+    sys.stderr.write(r.stderr[-4000:])
+    rec = None
+    for ln in r.stdout.splitlines():
+        s = ln.strip()
+        if s.startswith("{"):
+            try:
+                cand = json.loads(s)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and cand.get("harness") == "chaos_run":
+                rec = cand
+    if r.returncode != 0 or rec is None or not rec.get("match"):
+        sys.stderr.write(
+            f"error: chaos smoke failed (rc={r.returncode}): "
+            f"{r.stdout[-2000:]}\n"
+        )
+        raise SystemExit(1)
+    platform = os.environ.get("JAX_PLATFORMS", "cpu").split(",")[0] or "cpu"
+    out = core_record(
+        f"chaos_recovery_{args.model}_{platform}",
+        float(rec["recovery_s"]),
+        unit="seconds",
+    )
+    out.update(
+        mode=rec["mode"],
+        match=rec["match"],
+        reference_s=rec["reference_s"],
+        max_abs_diff=rec["max_abs_diff"],
+    )
+    print(json.dumps(out))
+    return 0
 
 
 def headline(args) -> int:
@@ -568,12 +626,22 @@ def main() -> int:
         "closed-loop synthetic clients, p50/p95/p99 latency in the record",
     )
     parser.add_argument(
+        "--chaos-smoke", action="store_true", dest="chaos_smoke",
+        help="run one kill-mid-epoch -> resume cycle through "
+        "tools/chaos_run.py and report RECOVERY TIME (seconds) in the "
+        "single-JSON-line contract (ROBUSTNESS.md)",
+    )
+    parser.add_argument(
         "--captures", type=int, default=3,
         help="fresh-process captures for the default headline (median "
         "wins; ~60-80s each warm — the compile cache skips compilation "
         "but every fresh process re-pays the one-time dataset staging)",
     )
     args = parser.parse_args()
+
+    if args.chaos_smoke:
+        # never touches a jax backend in this process (children own it)
+        return chaos_smoke(args)
 
     if not (
         args.pipeline

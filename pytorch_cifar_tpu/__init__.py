@@ -203,6 +203,18 @@ def enable_compilation_cache(path: str = None) -> None:
 
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower() == "cpu":
+        # jaxlib 0.4.36's XLA:CPU mis-executes DESERIALIZED cached
+        # executables under buffer donation: a warm-cache resume computes
+        # garbage metrics (NaN eval on a bit-exact restored state), then
+        # dies with glibc heap corruption or a segfault — found by the
+        # kill-and-resume chaos drill (ROBUSTNESS.md; deterministic
+        # in-process reproducer: warm second run of the pipelined fit).
+        # CPU compiles are seconds, so the cache buys nothing there —
+        # skip it entirely. TPU (where one compile costs minutes and the
+        # serialization path is exercised in production) keeps the cache.
+        return
+
     if path is None:
         # getpass.getuser() raises KeyError under a passwd-less UID (e.g.
         # k8s runAsUser) with no USER/LOGNAME set; fall back to the uid
@@ -219,3 +231,65 @@ def enable_compilation_cache(path: str = None) -> None:
     # but on this platform even tiny-model steps take minutes to compile
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _harden_cache_writes()
+
+
+def _harden_cache_writes() -> None:
+    """Make persistent-cache entry publication atomic (tmp + rename).
+
+    jaxlib 0.4.36's ``LRUCache.put`` writes the executable with a plain
+    ``cache_path.write_bytes(val)`` — NOT atomic. A process killed
+    mid-write (SIGKILL preemption, OOM-kill, power loss) leaves a torn
+    entry under the final name, and every later process deserializes
+    those garbage bytes as a valid executable: observed in this PR's
+    chaos drills as silently-wrong eval metrics (loss 2.4e7), NaN
+    training, and glibc heap aborts ('corrupted size vs. prev_size') —
+    the worst failure class there is, because nothing ever errors at the
+    cache layer. Wrapping the put with tmp + ``os.replace`` makes an
+    entry either absent or complete; a kill mid-write leaves only a
+    harmless ``*.tmp.<pid>`` orphan (swept here on the next call).
+
+    Version-gated: only the exact eviction-disabled shape this repo
+    configures is rewritten; anything else falls through to the
+    original implementation untouched.
+    """
+    import os
+    import time
+
+    try:
+        from jax._src import lru_cache
+    except ImportError:  # newer jax reworked the cache; nothing to patch
+        return
+    cls = getattr(lru_cache, "LRUCache", None)
+    if cls is None or getattr(cls.put, "_pct_atomic", False):
+        return
+    cache_suffix = getattr(lru_cache, "_CACHE_SUFFIX", "-cache")
+    atime_suffix = getattr(lru_cache, "_ATIME_SUFFIX", "-atime")
+    orig_put = cls.put
+
+    def put(self, key: str, val: bytes) -> None:
+        if getattr(self, "eviction_enabled", True):
+            # size-bounded configs take locks and do eviction accounting;
+            # this repo never enables that — don't second-guess it
+            return orig_put(self, key, val)
+        if not key:
+            raise ValueError("key cannot be empty")
+        cache_path = self.path / f"{key}{cache_suffix}"
+        if cache_path.exists():
+            return
+        # sweep tmp orphans from previously killed writers (bounded: one
+        # dir listing per compile, and compiles are rare by definition)
+        for stale in self.path.glob(f"{key}{cache_suffix}.tmp.*"):
+            try:
+                stale.unlink()
+            except OSError:
+                pass
+        tmp = self.path / f"{key}{cache_suffix}.tmp.{os.getpid()}"
+        tmp.write_bytes(val)
+        os.replace(tmp, cache_path)
+        (self.path / f"{key}{atime_suffix}").write_bytes(
+            time.time_ns().to_bytes(8, "little")
+        )
+
+    put._pct_atomic = True
+    cls.put = put

@@ -127,8 +127,28 @@ class TrainConfig:
     # is unaffected; only crash-durability granularity changes (SIGTERM
     # preemption still saves exactly). 0 = write on every improvement.
     checkpoint_every: int = 25
+    # Rolling checkpoint history (format v2, ROBUSTNESS.md): keep copies
+    # of the last N published versions of each checkpoint file as extra
+    # restore-fallback candidates. A corrupt current file (torn write,
+    # bit rot) then falls back to the previous version instead of the
+    # much older other-name checkpoint. 0 = no history.
+    keep_last_n: int = 2
     resume: bool = False
     evaluate: bool = False  # load the checkpoint, run eval only, no training
+
+    # Divergence sentinel (ROBUSTNESS.md): what to do when a train step's
+    # loss or gradient norm goes non-finite.
+    #   "off"      — reference behavior: NaN propagates into the params and
+    #                silently poisons every subsequent step (main.py has no
+    #                finiteness check anywhere).
+    #   "skip"     — discard that step's update via jnp.where (step counter
+    #                still advances, so LR schedule/rng stay aligned).
+    #   "rollback" — skip, and additionally restore the newest on-disk
+    #                checkpoint once `sentinel_budget` consecutive bad
+    #                steps accumulate (persistent divergence: a skipped
+    #                update cannot fix poisoned BN stats or a bad basin).
+    sentinel: str = "skip"
+    sentinel_budget: int = 3
 
     # misc
     seed: int = 0
@@ -162,6 +182,11 @@ class ServeConfig:
     max_batch: int = 0  # 0 = the largest bucket
     max_wait_ms: float = 2.0
     max_queue: int = 1024
+    # per-request deadline: a request still queued this many ms after
+    # submit fails fast with DeadlineExceeded instead of occupying a
+    # coalesced batch (an engine stall otherwise strands every queued
+    # caller on future.result() forever). 0 = no deadline.
+    deadline_ms: float = 0.0
 
     # checkpoint hot-reload: poll ckpt for a newer best checkpoint and
     # swap params atomically (in-flight requests keep their weights)

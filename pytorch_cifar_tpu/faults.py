@@ -1,0 +1,153 @@
+"""Fault injection: the registry behind the chaos harness (ROBUSTNESS.md).
+
+Production code never *behaves* differently because this module exists —
+each injection point is a read of an inert registry that tests and
+``tools/chaos_run.py`` arm on purpose. Injection points:
+
+- ``nan_loss`` (value = global step index): the train step poisons the
+  loss used for gradients at exactly that step (``train/steps.py``),
+  exercising the divergence sentinel's skip/rollback policies.
+- ``serve_error`` (optional ``times`` budget): ``InferenceEngine.predict``
+  raises before dispatch, exercising the micro-batcher's
+  fail-this-batch-only error containment.
+- :func:`truncate_file` / :func:`bitflip_file`: deterministic checkpoint
+  corruption for the manifest-verified fallback restore path
+  (``train/checkpoint.py``).
+
+Arming works two ways:
+
+- programmatic (in-process tests): ``faults.inject("nan_loss", 3)``,
+  cleaned up with ``faults.clear()``;
+- the ``PCT_FAULTS`` environment variable (subprocess chaos runs):
+  ``PCT_FAULTS="nan_loss=3"`` or ``PCT_FAULTS="serve_error;nan_loss=7"``
+  — parsed once at first use, so a chaos driver can arm a child
+  ``train.py``/``serve.py`` without touching its CLI surface.
+
+Stdlib-only on purpose: importable before jax initializes a backend.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+ENV_VAR = "PCT_FAULTS"
+
+_lock = threading.Lock()
+_active: Dict[str, Dict[str, Any]] = {}
+_env_loaded = False
+
+
+def _parse_value(raw: str) -> Any:
+    try:
+        return int(raw)
+    except ValueError:
+        return raw
+
+
+def _load_env_locked() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if not spec:
+        return
+    for part in spec.replace(",", ";").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, raw = part.partition("=")
+        entry: Dict[str, Any] = {"value": True, "times": None}
+        if raw:
+            entry["value"] = _parse_value(raw)
+        _active.setdefault(name.strip(), entry)
+
+
+def inject(name: str, value: Any = True, times: Optional[int] = None) -> None:
+    """Arm fault ``name``. ``times`` bounds how many triggers fire
+    (None = until cleared) — only consumed by :func:`maybe_raise`."""
+    with _lock:
+        _load_env_locked()
+        _active[name] = {"value": value, "times": times}
+
+
+def clear(name: Optional[str] = None) -> None:
+    """Disarm one fault (or all). Also forgets the env arming, so a test
+    that calls ``clear()`` fully resets the registry."""
+    global _env_loaded
+    with _lock:
+        _env_loaded = True  # do not resurrect env faults after a clear
+        if name is None:
+            _active.clear()
+        else:
+            _active.pop(name, None)
+
+
+def get(name: str, default: Any = None) -> Any:
+    """The armed value of ``name`` (or ``default`` when inert)."""
+    with _lock:
+        _load_env_locked()
+        entry = _active.get(name)
+        return default if entry is None else entry["value"]
+
+
+def is_active(name: str) -> bool:
+    return get(name) is not None and get(name) is not False
+
+
+def nan_loss_step() -> Optional[int]:
+    """Global step index at which the train step should poison the loss,
+    or None when inert. Read at trace/closure-build time by
+    ``make_train_step`` — arm BEFORE constructing the Trainer/step."""
+    v = get("nan_loss")
+    if v is None or v is False:
+        return None
+    return int(v) if v is not True else 0
+
+
+def maybe_raise(name: str, exc: type = RuntimeError) -> None:
+    """Raise ``exc`` if fault ``name`` is armed, consuming one unit of its
+    ``times`` budget (a budget of 1 gives exactly one failure)."""
+    with _lock:
+        _load_env_locked()
+        entry = _active.get(name)
+        if entry is None:
+            return
+        if entry["times"] is not None:
+            if entry["times"] <= 0:
+                return
+            entry["times"] -= 1
+            if entry["times"] == 0:
+                _active.pop(name, None)
+    raise exc(f"injected fault: {name}")
+
+
+# -- checkpoint corruption helpers (chaos harness + tests) ---------------
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
+    """Truncate ``path`` to ``keep_fraction`` of its size — the torn-write
+    shape a host crash mid-write leaves behind. Returns the new size."""
+    size = os.path.getsize(path)
+    keep = max(0, int(size * keep_fraction))
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return keep
+
+
+def bitflip_file(path: str, offset: Optional[int] = None) -> int:
+    """Flip one bit in ``path`` (middle byte by default) — silent media
+    corruption that only a checksum can catch (the file stays the same
+    size and often still parses). Returns the flipped offset."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot bitflip empty file {path!r}")
+    off = size // 2 if offset is None else offset
+    with open(path, "rb+") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0x40]))
+    return off

@@ -22,6 +22,7 @@ See SERVING.md for the architecture and tuning knobs.
 
 from pytorch_cifar_tpu.serve.batcher import (  # noqa: F401
     BatcherClosed,
+    DeadlineExceeded,
     MicroBatcher,
     QueueFull,
 )

@@ -15,10 +15,19 @@ piece that turns N independent clients into that shape:
   bound — under sustained overload an unbounded queue converts overload
   into unbounded latency for EVERY request, which is strictly worse than
   telling some clients to back off (they retry; see loadgen).
+- **Deadlines**: a request may carry a deadline (per-submit ``deadline_ms``
+  or the constructor default). A request whose deadline passes while it is
+  still queued fails fast with :class:`DeadlineExceeded` at batch-formation
+  time instead of occupying a coalesced batch — when the engine stalls,
+  callers get a bounded-latency error they can retry elsewhere, not a
+  forever-pending future (ROBUSTNESS.md).
 - **Graceful drain**: ``close()`` rejects new submissions immediately,
   finishes everything already admitted (so accepted requests are never
   dropped), then stops the worker. ``close(drain=False)`` fails pending
-  requests with :class:`BatcherClosed` for fast teardown.
+  requests with :class:`BatcherClosed` immediately — and if the worker
+  does not exit within ``timeout`` (wedged in a stalled engine call),
+  whatever is still queued is failed too, so no caller is ever left
+  blocked forever on ``future.result()``.
 """
 
 from __future__ import annotations
@@ -40,13 +49,18 @@ class BatcherClosed(RuntimeError):
     """The batcher is shutting down and accepts no new requests."""
 
 
-class _Pending:
-    __slots__ = ("x", "n", "future")
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed while it was still queued."""
 
-    def __init__(self, x: np.ndarray):
+
+class _Pending:
+    __slots__ = ("x", "n", "future", "expires_at")
+
+    def __init__(self, x: np.ndarray, expires_at: Optional[float] = None):
         self.x = x
         self.n = x.shape[0]
         self.future: Future = Future()
+        self.expires_at = expires_at  # time.monotonic() deadline, or None
 
 
 class MicroBatcher:
@@ -57,6 +71,7 @@ class MicroBatcher:
         max_batch: Optional[int] = None,
         max_wait_ms: float = 2.0,
         max_queue: int = 1024,
+        default_deadline_ms: float = 0.0,
         autostart: bool = True,
     ):
         self.engine = engine
@@ -68,6 +83,7 @@ class MicroBatcher:
         if self.max_queue < self.max_batch:
             # a queue smaller than one batch could never fill a batch
             raise ValueError("max_queue must be >= max_batch")
+        self.default_deadline_ms = float(default_deadline_ms)
         self._q: deque = deque()
         self._queued_images = 0
         self._cond = threading.Condition()
@@ -80,6 +96,7 @@ class MicroBatcher:
             "images": 0,
             "batches": 0,
             "rejected": 0,
+            "expired": 0,
             "largest_batch": 0,
         }
         if autostart:
@@ -87,11 +104,20 @@ class MicroBatcher:
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, images: np.ndarray) -> Future:
+    def submit(
+        self, images: np.ndarray, deadline_ms: Optional[float] = None
+    ) -> Future:
         """Enqueue a request; the Future resolves to fp32 logits for
         exactly these rows. Raises QueueFull/BatcherClosed synchronously
-        so the caller can apply backpressure without blocking."""
-        req = _Pending(np.asarray(images))
+        so the caller can apply backpressure without blocking.
+        ``deadline_ms`` bounds queue time (falls back to the constructor's
+        ``default_deadline_ms``; 0/None = no deadline)."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        expires_at = (
+            time.monotonic() + deadline_ms / 1e3 if deadline_ms else None
+        )
+        req = _Pending(np.asarray(images), expires_at)
         if req.n < 1:
             raise ValueError("empty request")
         with self._cond:
@@ -122,13 +148,39 @@ class MicroBatcher:
             )
             self._thread.start()
 
+    def _fail_expired_locked(self) -> None:
+        """Fail every queued request whose deadline has passed (caller
+        holds the lock). Runs at batch-formation time: an expired request
+        must not occupy a coalesced batch, and after an engine stall the
+        backlog fails fast instead of being served pointlessly late."""
+        if not any(r.expires_at is not None for r in self._q):
+            return
+        now = time.monotonic()
+        kept: deque = deque()
+        for req in self._q:
+            if req.expires_at is not None and now >= req.expires_at:
+                self._queued_images -= req.n
+                self.stats["expired"] += 1
+                req.future.set_exception(
+                    DeadlineExceeded(
+                        f"request expired after "
+                        f"{(now - req.expires_at) * 1e3:.1f} ms past its "
+                        f"deadline while queued"
+                    )
+                )
+            else:
+                kept.append(req)
+        self._q = kept
+
     def _take_batch(self):
         """Block until work exists, then coalesce up to max_batch images,
         waiting at most max_wait_ms after the first request is picked up.
         Returns [] only at shutdown with an empty queue."""
         with self._cond:
+            self._fail_expired_locked()
             while not self._q and not self._closed:
                 self._cond.wait()
+                self._fail_expired_locked()
             if not self._q:
                 return []  # closed and fully drained
             batch = [self._q.popleft()]
@@ -136,11 +188,25 @@ class MicroBatcher:
             deadline = time.monotonic() + self.max_wait_ms / 1e3
             while total < self.max_batch:
                 if self._q:
-                    if total + self._q[0].n > self.max_batch:
+                    head = self._q[0]
+                    if (
+                        head.expires_at is not None
+                        and time.monotonic() >= head.expires_at
+                    ):
+                        # expired while coalescing: fail it, keep going
+                        self._q.popleft()
+                        self._queued_images -= head.n
+                        self.stats["expired"] += 1
+                        head.future.set_exception(
+                            DeadlineExceeded(
+                                "request deadline passed while queued"
+                            )
+                        )
+                        continue
+                    if total + head.n > self.max_batch:
                         break  # requests are never split across batches
-                    req = self._q.popleft()
-                    batch.append(req)
-                    total += req.n
+                    batch.append(self._q.popleft())
+                    total += head.n
                 else:
                     if self._closed:
                         break  # draining: don't wait for traffic that
@@ -149,6 +215,7 @@ class MicroBatcher:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
+                    self._fail_expired_locked()
                     if not self._q:
                         break  # timeout or spurious wake with no work
             self._queued_images -= total
@@ -188,15 +255,38 @@ class MicroBatcher:
 
     # -- lifecycle -----------------------------------------------------
 
+    def _fail_queued_locked(self, exc: Exception) -> None:
+        while self._q:
+            req = self._q.popleft()
+            self._queued_images -= req.n
+            req.future.set_exception(exc)
+
     def close(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop accepting requests; by default finish everything already
-        admitted before the worker exits."""
+        admitted before the worker exits. ``drain=False`` fails all
+        pending futures immediately; a worker that misses ``timeout``
+        (stalled engine call) has its remaining queue failed too — either
+        way no caller stays blocked forever on ``future.result()``."""
         with self._cond:
             self._closed = True
             self._drain = drain
+            if not drain:
+                # fail HERE, not in the worker: the worker may be wedged
+                # inside a stalled engine.predict and never reach the queue
+                self._fail_queued_locked(
+                    BatcherClosed("batcher closed without drain")
+                )
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                with self._cond:
+                    self._fail_queued_locked(
+                        BatcherClosed(
+                            f"batcher close timed out after {timeout}s "
+                            "with the worker still busy; request abandoned"
+                        )
+                    )
 
     def __enter__(self):
         self.start()

@@ -31,6 +31,8 @@ from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pytorch_cifar_tpu import faults
+
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 
@@ -54,8 +56,10 @@ def load_checkpoint_trees(
     import json
 
     from pytorch_cifar_tpu.train.checkpoint import (
+        CheckpointCorrupt,
         best_checkpoint_order,
         meta_path,
+        verify_checkpoint_payload,
     )
 
     path = ckpt
@@ -99,7 +103,7 @@ def load_checkpoint_trees(
     from flax import serialization
 
     with open(path, "rb") as f:
-        tree = serialization.msgpack_restore(f.read())
+        payload = f.read()
     # the canonical sidecar rule (checkpoint.meta_path): <stem>.json next
     # to the msgpack
     sidecar = meta_path(os.path.dirname(path) or ".", os.path.basename(path))
@@ -108,6 +112,18 @@ def load_checkpoint_trees(
             meta = json.load(f)
     except (OSError, ValueError):
         meta = {}
+    # integrity gate (format v2, ROBUSTNESS.md): a truncated payload, a
+    # bit-flipped byte, or a payload/sidecar pair from two different
+    # publishes raises CheckpointCorrupt HERE — before any bytes reach the
+    # engine — instead of failing deep inside msgpack or silently serving
+    # wrong weights. v1 sidecars (no manifest) pass with a warning.
+    verify_checkpoint_payload(payload, meta, path)
+    try:
+        tree = serialization.msgpack_restore(payload)
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"{path}: undeserializable payload: {e}"
+        ) from e
     return tree["params"], tree.get("batch_stats", {}), meta
 
 
@@ -271,6 +287,10 @@ class InferenceEngine:
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         """uint8 NHWC batch of any size -> fp32 logits ``(n, classes)``."""
+        # chaos injection point (inert unless armed): an engine failure
+        # must fail only its own batch in the micro-batcher, never the
+        # serving process
+        faults.maybe_raise("serve_error")
         x = np.asarray(images)
         if x.ndim != 4 or x.shape[1:] != self.image_shape:
             raise ValueError(

@@ -1,16 +1,22 @@
 """Checkpoint hot-reload: track a concurrently-training run.
 
 A serving process pointed at a Trainer ``output_dir`` polls for a newer
-best-params checkpoint (``ckpt.msgpack`` + sidecar — the atomic tmp+rename
-write in ``train/checkpoint.py`` guarantees the watcher never sees a torn
-file) and swaps the new weights into the engine via
-:meth:`InferenceEngine.swap_weights`. The swap is a single reference
-assignment validated against the compiled programs' avals, so:
+best-params checkpoint (``ckpt.msgpack`` + sidecar) and swaps the new
+weights into the engine via :meth:`InferenceEngine.swap_weights`. The swap
+is a single reference assignment validated against the compiled programs'
+avals, so:
 
 - in-flight requests finish on the weights they captured (nothing drops),
 - no recompile happens (same model, same shapes/dtypes), and
 - a wrong checkpoint (different model trained into the same dir) is
   rejected loudly while serving continues on the previous weights.
+
+**A half-written checkpoint is never served** (ROBUSTNESS.md): the loader
+verifies the sidecar's CRC32/size manifest against the payload before the
+swap, and the watcher re-stats the payload after the read — so a torn
+write, a payload/sidecar pair from two different publishes (the trainer
+renames them one after the other), or a publish racing the read all skip
+this poll and retry on the next one, instead of poisoning the engine.
 
 Polling, not inotify: the output dir may be NFS/FUSE on a TPU host where
 inotify is unreliable, and a multi-second poll is far below any
@@ -24,7 +30,7 @@ import os
 import threading
 from typing import Optional
 
-from pytorch_cifar_tpu.train.checkpoint import CKPT_NAME
+from pytorch_cifar_tpu.train.checkpoint import CKPT_NAME, CheckpointCorrupt
 
 log = logging.getLogger(__name__)
 
@@ -32,8 +38,8 @@ log = logging.getLogger(__name__)
 class CheckpointWatcher:
     """Poll ``ckpt_dir`` for a new ``name`` checkpoint; swap it into
     ``engine``. Start with :meth:`start` (or as a context manager), stop
-    with :meth:`stop`. ``reloads``/``errors``/``last_meta`` are
-    observable for tests and CLI reporting."""
+    with :meth:`stop`. ``reloads``/``errors``/``skipped``/``last_meta``
+    are observable for tests and CLI reporting."""
 
     def __init__(
         self,
@@ -49,6 +55,9 @@ class CheckpointWatcher:
         self.poll_s = float(poll_s)
         self.reloads = 0
         self.errors = 0
+        # polls that saw a torn/in-progress publish and deferred (the
+        # checkpoint will be picked up complete on a later poll)
+        self.skipped = 0
         self.last_meta: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -70,9 +79,10 @@ class CheckpointWatcher:
         return (st.st_ino, st.st_mtime_ns, st.st_size)
 
     def poll_once(self) -> bool:
-        """One poll step: reload iff the file signature changed. Returns
-        True when a swap happened. Split out so tests can drive the
-        watcher without timing dependence."""
+        """One poll step: reload iff the file signature changed AND the
+        manifest-verified load succeeds. Returns True when a swap
+        happened. Split out so tests can drive the watcher without
+        timing dependence."""
         sig = self._signature()
         if sig is None or sig == self._last_sig:
             return False
@@ -84,11 +94,40 @@ class CheckpointWatcher:
                 self.engine.model_name,
                 num_classes=self.engine.num_classes,
             )
+        except CheckpointCorrupt as e:
+            # torn or mid-publish checkpoint: do NOT remember the
+            # signature — the payload/sidecar pair should become
+            # consistent by the next poll (the trainer publishes the
+            # sidecar right after the payload); a permanently corrupt
+            # file just keeps being skipped, never served
+            log.warning("skipping torn/corrupt checkpoint: %s", e)
+            self.skipped += 1
+            return False
+        except Exception:
+            # unreadable for a non-integrity reason (e.g. deleted mid
+            # read); remember the signature so a permanently broken file
+            # isn't re-read every poll
+            log.exception("checkpoint reload failed (%s)", self._path())
+            self.errors += 1
+            self._last_sig = sig
+            return False
+        if self._signature() != sig:
+            # payload replaced while we were reading the pair: the meta
+            # we hold may describe the OLD payload (rename race between
+            # ckpt.msgpack and its sidecar). Defer to the next poll,
+            # which will see the settled pair.
+            log.info(
+                "checkpoint %s republished mid-read; deferring swap one "
+                "poll", self._path(),
+            )
+            self.skipped += 1
+            return False
+        try:
             version = self.engine.swap_weights(params, stats)
         except Exception:
-            # keep serving the previous weights; remember the bad
-            # signature so a broken file isn't re-read every poll
-            log.exception("checkpoint reload failed (%s)", self._path())
+            # wrong-model checkpoint: keep serving the previous weights;
+            # remember the signature so it isn't re-tried every poll
+            log.exception("checkpoint swap rejected (%s)", self._path())
             self.errors += 1
             self._last_sig = sig
             return False

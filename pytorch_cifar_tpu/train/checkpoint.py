@@ -6,16 +6,28 @@ buffers), step, epoch, and best_acc all round-trip, so a resumed run
 continues the exact momentum + LR trajectory (the reference restarts both,
 SURVEY.md §3.4). Same best-accuracy gating semantics (main.py:136-148).
 
-Format: flax msgpack of the array pytree + a JSON sidecar for scalars.
-Writes are atomic (tmp + rename) and process-0-only under multi-host SPMD
-(rank-0 gating parity, main_dist.py:243).
+Format v2 (ROBUSTNESS.md): flax msgpack of the array pytree + a JSON
+sidecar carrying the scalars AND a payload manifest (CRC32 + size). Writes
+are atomic and durable — tmp file fsync'd before the rename, directory
+fsync'd after — and process-0-only under multi-host SPMD (rank-0 gating
+parity, main_dist.py:243). Restore verifies the manifest and falls back
+through the candidate order on ANY corruption (truncated payload, bad
+msgpack, checksum mismatch), not just a missing file; under multi-host the
+winning candidate is process 0's decision, broadcast to every host, so no
+host can diverge. v1 checkpoints (no manifest) still restore, with a
+logged warning. ``keep_last_n`` keeps a rolling history of prior
+checkpoint versions as extra fallback candidates.
 """
 
 from __future__ import annotations
 
+import glob
 import json
+import logging
 import os
-from typing import Any, Optional, Tuple
+import re
+import zlib
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -23,8 +35,17 @@ from flax import serialization
 
 from pytorch_cifar_tpu.train.state import TrainState
 
+log = logging.getLogger(__name__)
+
 CKPT_NAME = "ckpt.msgpack"   # best-accuracy checkpoint (reference semantics)
 LAST_NAME = "last.msgpack"   # preemption save: exact latest state
+
+MANIFEST_FORMAT = 2
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint payload failed verification (checksum/size mismatch or
+    undeserializable bytes). Restore falls back; serving skips the swap."""
 
 
 def meta_path(output_dir: str, name: str) -> str:
@@ -32,14 +53,139 @@ def meta_path(output_dir: str, name: str) -> str:
     return os.path.join(output_dir, os.path.splitext(name)[0] + ".json")
 
 
+def payload_manifest(payload: bytes) -> dict:
+    """The sidecar manifest entry that lets any reader verify the payload
+    without deserializing it (format v2)."""
+    return {
+        "format": MANIFEST_FORMAT,
+        "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        "size": len(payload),
+    }
+
+
+def verify_checkpoint_payload(payload: bytes, meta: dict, path: str) -> None:
+    """Check ``payload`` against the sidecar ``meta``'s manifest.
+
+    Raises :class:`CheckpointCorrupt` on size/checksum mismatch. A sidecar
+    without a manifest (format v1, pre-robustness checkpoints) passes with
+    a logged warning — old checkpoints must keep restoring."""
+    manifest = (meta or {}).get("manifest")
+    if not manifest:
+        log.warning(
+            "checkpoint %s has no manifest (format v1): restoring "
+            "unverified — re-save to upgrade to format v2", path
+        )
+        return
+    if len(payload) != int(manifest.get("size", -1)):
+        raise CheckpointCorrupt(
+            f"{path}: payload is {len(payload)} bytes, manifest says "
+            f"{manifest.get('size')} (truncated or torn write)"
+        )
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if crc != int(manifest.get("crc32", -1)):
+        raise CheckpointCorrupt(
+            f"{path}: payload crc32 {crc:#010x} != manifest "
+            f"{int(manifest.get('crc32', -1)):#010x} (bit corruption)"
+        )
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """Durably record a rename in its directory. Best-effort: some
+    filesystems (FUSE/NFS mounts on TPU hosts) reject directory fsync."""
+    try:
+        fd = os.open(dirpath or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename + dir fsync: after this returns, a crash at
+    ANY point leaves either the old complete file or the new complete
+    file — never a zero-length or half-written "atomically" renamed one
+    (an os.replace of an unfsynced tmp can journal the rename before the
+    data blocks reach disk)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+# -- rolling history -----------------------------------------------------
+
+def _history_stem(name: str) -> str:
+    return os.path.splitext(name)[0]
+
+
+def _history_name(name: str, epoch: int) -> str:
+    return f"{_history_stem(name)}-e{max(int(epoch), 0):05d}.msgpack"
+
+
+def history_names(output_dir: str, name: str):
+    """Rolling-history checkpoint names for ``name``, newest epoch first —
+    the extra fallback candidates behind the primary file."""
+    pat = re.compile(
+        re.escape(_history_stem(name)) + r"-e(\d+)\.msgpack$"
+    )
+    found = []
+    for path in glob.glob(
+        os.path.join(output_dir, _history_stem(name) + "-e*.msgpack")
+    ):
+        m = pat.search(os.path.basename(path))
+        if m:
+            found.append((int(m.group(1)), os.path.basename(path)))
+    return [n for _, n in sorted(found, reverse=True)]
+
+
+def _update_history(
+    output_dir: str, name: str, epoch: int, payload: bytes, meta: dict,
+    keep_last_n: int,
+) -> None:
+    """Publish a history copy of the just-written checkpoint and prune the
+    oldest entries beyond ``keep_last_n``. Copies (not hardlinks): a
+    separate inode means corruption of the primary file cannot reach its
+    history fallback."""
+    hname = _history_name(name, epoch)
+    _atomic_write(os.path.join(output_dir, hname), payload)
+    _atomic_write(
+        meta_path(output_dir, hname),
+        json.dumps(meta).encode(),
+    )
+    for stale in history_names(output_dir, name)[keep_last_n:]:
+        for p in (
+            os.path.join(output_dir, stale),
+            meta_path(output_dir, stale),
+        ):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+# -- save ----------------------------------------------------------------
+
 def save_checkpoint(
     output_dir: str,
     state: TrainState,
     epoch: int,
     best_acc: float,
     name: str = CKPT_NAME,
+    keep_last_n: int = 0,
 ) -> Optional[str]:
-    """Write state to ``output_dir`` (process 0 only). Returns the path."""
+    """Write state to ``output_dir`` (process 0 only). Returns the path.
+
+    Write order is part of the format: payload first, sidecar (carrying
+    the payload's manifest) second — a reader that verifies the manifest
+    therefore never trusts a payload/sidecar pairing from two different
+    publishes (serve/reload.py gates its hot swap on exactly this)."""
     if jax.process_index() != 0:
         return None
     os.makedirs(output_dir, exist_ok=True)
@@ -54,17 +200,16 @@ def save_checkpoint(
     )
     payload = serialization.to_bytes(host_state)
     path = os.path.join(output_dir, name)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)
+    _atomic_write(path, payload)
 
-    meta = {"epoch": int(epoch), "best_acc": float(best_acc)}
-    mpath = meta_path(output_dir, name)
-    tmp = mpath + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, mpath)
+    meta = {
+        "epoch": int(epoch),
+        "best_acc": float(best_acc),
+        "manifest": payload_manifest(payload),
+    }
+    _atomic_write(meta_path(output_dir, name), json.dumps(meta).encode())
+    if keep_last_n > 0:
+        _update_history(output_dir, name, epoch, payload, meta, keep_last_n)
     return path
 
 
@@ -105,34 +250,104 @@ def remove_stale_last(output_dir: str) -> None:
     Trainer.fit and tools/accuracy_run.py so the rule cannot drift."""
     if jax.process_index() != 0 or not output_dir:
         return
-    for path in (
-        os.path.join(output_dir, LAST_NAME),
-        meta_path(output_dir, LAST_NAME),
-    ):
-        try:
-            os.remove(path)
-        except OSError:
-            pass
+    stale = [LAST_NAME] + history_names(output_dir, LAST_NAME)
+    for name in stale:
+        for path in (
+            os.path.join(output_dir, name),
+            meta_path(output_dir, name),
+        ):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+# -- restore -------------------------------------------------------------
+
+def _read_verified(output_dir: str, name: str, target) -> Tuple[Any, int, float]:
+    """Read + verify + deserialize one candidate. FileNotFoundError means
+    "candidate absent" (silent skip); CheckpointCorrupt means "candidate
+    exists but is unusable" (logged skip)."""
+    path = os.path.join(output_dir, name)
+    with open(path, "rb") as f:
+        payload = f.read()
+    meta: dict = {}
+    try:
+        with open(meta_path(output_dir, name)) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        meta = {}
+    verify_checkpoint_payload(payload, meta, path)
+    try:
+        restored = serialization.from_bytes(target, payload)
+    except Exception as e:  # flax/msgpack raise a zoo of decode errors
+        raise CheckpointCorrupt(f"{path}: undeserializable payload: {e}") from e
+    return restored, int(meta.get("epoch", -1)), float(meta.get("best_acc", 0.0))
 
 
 def restore_checkpoint(
-    output_dir: str, state: TrainState, name: str = CKPT_NAME
+    output_dir: str,
+    state: TrainState,
+    name: str = CKPT_NAME,
+    names: Optional[Sequence[str]] = None,
 ) -> Tuple[TrainState, int, float]:
     """Load ``output_dir``'s checkpoint into ``state``'s structure.
 
-    Returns (state, start_epoch, best_acc); start_epoch is the next epoch to
-    run (saved epoch + 1).
+    ``names`` (e.g. :func:`newest_checkpoint_order`) gives the candidate
+    preference; each candidate is expanded with its rolling history, and
+    restore falls back through the list on ANY corruption — a truncated
+    payload, a checksum mismatch, or undeserializable bytes all behave
+    like a missing file with a warning, never a crash deep inside flax.
+    Raises FileNotFoundError only when NO candidate is usable.
+
+    Returns (state, start_epoch, best_acc); start_epoch is the next epoch
+    to run (saved epoch + 1).
     """
-    path = os.path.join(output_dir, name)
+    candidates = list(names) if names is not None else [name]
     multihost = jax.process_count() > 1
     if multihost:
         from jax.experimental import multihost_utils
+
+    target = {
+        "params": jax.device_get(state.params),
+        "batch_stats": jax.device_get(state.batch_stats),
+        "opt_state": jax.device_get(state.opt_state),
+        "step": np.zeros((), np.int32),
+    }
     # Saves are process-0-only, so under multi-host without a shared
-    # filesystem only process 0 sees the file. Process 0 decides whether a
-    # checkpoint exists and every process follows that decision, then the
-    # restored arrays are broadcast — no per-host file requirement, and no
-    # host can diverge (raise vs proceed) and deadlock the collective job.
-    have_ckpt = os.path.isfile(path)
+    # filesystem only process 0 sees the files. Process 0 walks the
+    # candidate order, decides which checkpoint wins, and every process
+    # follows that decision via broadcast — no per-host file requirement,
+    # and no host can diverge (raise vs proceed, or restore DIFFERENT
+    # candidates) and deadlock the collective job.
+    restored = None
+    epoch, best_acc = -1, 0.0
+    if jax.process_index() == 0:
+        expanded = []
+        for cand in candidates:
+            expanded.append(cand)
+            expanded.extend(history_names(output_dir, cand))
+        for cand in expanded:
+            try:
+                restored, epoch, best_acc = _read_verified(
+                    output_dir, cand, target
+                )
+            except FileNotFoundError:
+                continue
+            except CheckpointCorrupt as e:
+                log.warning(
+                    "checkpoint candidate %s is corrupt (%s); "
+                    "falling back", cand, e
+                )
+                continue
+            if cand != expanded[0]:
+                log.warning(
+                    "restored fallback checkpoint %s (epoch %d) — the "
+                    "preferred candidate was missing or corrupt",
+                    cand, epoch,
+                )
+            break
+    have_ckpt = restored is not None
     if multihost:
         have_ckpt = bool(
             multihost_utils.broadcast_one_to_all(
@@ -141,28 +356,11 @@ def restore_checkpoint(
         )
     if not have_ckpt:
         raise FileNotFoundError(
-            f"no checkpoint at {path!r} — run without --resume first "
-            "(parity: main.py:79 asserts ./checkpoint exists)"
+            f"no usable checkpoint in {output_dir!r} "
+            f"(tried {candidates} and their history) — run without "
+            "--resume first (parity: main.py:79 asserts ./checkpoint exists)"
         )
-
-    target = {
-        "params": jax.device_get(state.params),
-        "batch_stats": jax.device_get(state.batch_stats),
-        "opt_state": jax.device_get(state.opt_state),
-        "step": np.zeros((), np.int32),
-    }
-    epoch, best_acc = -1, 0.0
-    if jax.process_index() == 0:
-        with open(path, "rb") as f:
-            payload = f.read()
-        restored = serialization.from_bytes(target, payload)
-        mpath = meta_path(output_dir, name)
-        if os.path.isfile(mpath):
-            with open(mpath) as f:
-                meta = json.load(f)
-            epoch = int(meta.get("epoch", -1))
-            best_acc = float(meta.get("best_acc", 0.0))
-    else:
+    if restored is None:
         restored = target  # placeholder structure; overwritten by broadcast
     if multihost:
         restored, scalars = multihost_utils.broadcast_one_to_all(

@@ -61,6 +61,10 @@ def _metrics(logits, labels) -> Metrics:
         "loss_sum": loss_sum,
         "correct": correct.astype(jnp.float32),
         "count": n_valid.astype(jnp.float32),
+        # divergence sentinel: count of (shard, step) observations whose
+        # loss went non-finite; the train step additionally folds in the
+        # gradient-norm check (trainer.py applies the skip/rollback policy)
+        "nonfinite": (~jnp.isfinite(loss_sum)).astype(jnp.float32),
     }
 
 
@@ -74,6 +78,7 @@ def make_train_step(
     axis_name: Optional[str] = None,
     remat: bool = False,
     sync_bn: bool = False,
+    skip_nonfinite: bool = False,
 ) -> Callable:
     """Returns step(state, batch=(uint8 images, labels), rng) -> (state, metrics).
 
@@ -87,9 +92,26 @@ def make_train_step(
     so normalization matches single-device BN over the global batch. The
     default (False) matches the reference's per-replica BN under DDP
     (SURVEY.md §7.2).
+
+    ``skip_nonfinite=True`` is the divergence sentinel's step half
+    (ROBUSTNESS.md): when the loss or the (post-all-reduce) gradient norm
+    goes non-finite, the parameter/optimizer/BN update is DISCARDED via
+    ``jnp.where`` — the step counter still advances, so the LR schedule
+    and per-step rng stream stay aligned with a clean run — and the
+    ``nonfinite`` metric reports the event. The flag is replica-agreed
+    (psum over ``axis_name``) so data-parallel shards can never split on
+    the skip decision and diverge. A finite step pays one scalar select
+    per leaf; results are bit-identical to the unguarded step.
     """
     if sync_bn and axis_name is None:
         raise ValueError("sync_bn requires a data-parallel axis_name")
+    # fault-injection point (chaos harness): poison the gradient loss at
+    # one global step. Read ONCE when the step closure is built, so the
+    # compiled program is static; inert unless faults.inject("nan_loss", k)
+    # or PCT_FAULTS=nan_loss=k armed it before the Trainer was constructed.
+    from pytorch_cifar_tpu import faults
+
+    nan_step = faults.nan_loss_step()
 
     def step(state: TrainState, batch, rng) -> Tuple[TrainState, Metrics]:
         images, labels = batch
@@ -132,6 +154,19 @@ def make_train_step(
                 n_global = jax.lax.psum(n_valid, axis_name)
                 n_dev = jax.lax.psum(jnp.ones((), jnp.float32), axis_name)
                 loss = loss_sum * n_dev / jnp.maximum(n_global, 1)
+            if nan_step is not None:
+                # chaos injection: NaN at one global step (or every step
+                # when armed with a negative value). MULTIPLIED in, not
+                # selected in: d(where(c, nan, loss))/dloss is 0 on the
+                # constant branch, which would leave the gradients clean —
+                # a NaN factor poisons loss AND every gradient, exactly
+                # like a real numeric blow-up
+                trigger = (
+                    jnp.asarray(True)
+                    if nan_step < 0
+                    else state.step == nan_step
+                )
+                loss = loss * jnp.where(trigger, jnp.float32(jnp.nan), 1.0)
             return loss, (logits, mutated.get("batch_stats", state.batch_stats))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
@@ -143,24 +178,47 @@ def make_train_step(
             grads = jax.lax.pmean(grads, axis_name)
             if not sync_bn:  # under sync_bn stats are already replica-identical
                 new_stats = jax.lax.pmean(new_stats, axis_name)
+        # sentinel flag: loss is shard-local, the grad norm is computed on
+        # the post-pmean (replica-identical) gradients; psum'ing the local
+        # verdict makes every shard see the same boolean, so the skip below
+        # can never leave shards holding different parameters
+        bad = jnp.logical_or(
+            ~jnp.isfinite(loss), ~jnp.isfinite(optax.global_norm(grads))
+        )
+        if axis_name is not None:
+            bad = jax.lax.psum(bad.astype(jnp.float32), axis_name) > 0
             metrics = jax.tree_util.tree_map(
                 lambda m: jax.lax.psum(m, axis_name), metrics
             )
-        state = state.apply_gradients(grads)
-        state = state.replace(batch_stats=new_stats)
-        return state, metrics
+        # exactly 0/1 per step regardless of shard count, so the epoch
+        # total is a bad-STEP count (the budget the trainer reasons about)
+        metrics["nonfinite"] = jnp.maximum(
+            (metrics["nonfinite"] > 0).astype(jnp.float32),
+            bad.astype(jnp.float32),
+        )
+        new_state = state.apply_gradients(grads)
+        new_state = new_state.replace(batch_stats=new_stats)
+        if skip_nonfinite:
+            # discard the poisoned update but keep the step counter moving
+            # (LR schedule + rng stream stay aligned with a clean run)
+            safe = state.replace(step=new_state.step)
+            new_state = jax.tree_util.tree_map(
+                lambda o, n: jnp.where(bad, o, n), safe, new_state
+            )
+        return new_state, metrics
 
     return step
 
 
 def zero_metrics() -> Metrics:
-    """Initial value for the on-device running metric sums. Three DISTINCT
+    """Initial value for the on-device running metric sums. DISTINCT
     arrays: the epoch fns donate this argument, and aliasing one buffer
     across leaves trips XLA's donate-same-buffer-twice check."""
     return {
         "loss_sum": jnp.zeros((), jnp.float32),
         "correct": jnp.zeros((), jnp.float32),
         "count": jnp.zeros((), jnp.float32),
+        "nonfinite": jnp.zeros((), jnp.float32),
     }
 
 

@@ -259,24 +259,21 @@ class Trainer:
             # ckpt — a stale one left by an earlier preemption must not roll
             # training back or clobber the true best via its old best_acc.
             # Eval-only always wants the best-accuracy params.
+            # restore_checkpoint verifies each candidate's manifest and
+            # falls back through the order (and each file's rolling
+            # history) on ANY corruption — a truncated last.msgpack no
+            # longer kills the resume (ROBUSTNESS.md).
             names = (
                 best_checkpoint_order(config.output_dir)
                 if config.evaluate
                 else self._resume_order(config.output_dir)
             )
-            for name in names:
-                try:
-                    state, self.start_epoch, self.best_acc = (
-                        restore_checkpoint(config.output_dir, state, name)
-                    )
-                    break
-                except FileNotFoundError:
-                    if name == names[-1]:
-                        raise
+            state, self.start_epoch, self.best_acc = restore_checkpoint(
+                config.output_dir, state, names=names
+            )
             log.info(
-                "resumed from %s (%s): epoch %d, best_acc %.2f",
+                "resumed from %s: epoch %d, best_acc %.2f",
                 config.output_dir,
-                name,
                 self.start_epoch,
                 self.best_acc,
             )
@@ -286,6 +283,10 @@ class Trainer:
         compute = jnp.bfloat16 if config.amp else jnp.float32
         # on-device augmentation unless the host pipeline already did it
         device_augment = not host_aug
+        if config.sentinel not in ("off", "skip", "rollback"):
+            raise ValueError(
+                f"sentinel must be off/skip/rollback, got {config.sentinel!r}"
+            )
         step_kwargs = dict(
             crop=config.random_crop and device_augment,
             flip=config.random_flip and device_augment,
@@ -293,6 +294,10 @@ class Trainer:
             std=config.std,
             compute_dtype=compute,
             remat=config.remat,
+            # divergence sentinel step half: discard non-finite updates
+            # in-graph; the policy half (_apply_sentinel) runs on the
+            # per-epoch totals
+            skip_nonfinite=config.sentinel != "off",
         )
         eval_kwargs = dict(
             mean=config.mean, std=config.std, compute_dtype=compute
@@ -400,6 +405,10 @@ class Trainer:
         self._snapshot = None  # (state copy, epoch, best_acc)
         self._save_thread = None
         self._written_epoch = None
+        # divergence-sentinel policy state (ROBUSTNESS.md): consecutive
+        # non-finite-step counter + observable totals for tests/CLIs
+        self._consec_bad = 0
+        self.fault_stats = {"bad_steps": 0, "rollbacks": 0}
 
     # ------------------------------------------------------------------
 
@@ -411,6 +420,65 @@ class Trainer:
         )
 
         return newest_checkpoint_order(output_dir)
+
+    # -- divergence sentinel (policy half; step half is skip_nonfinite) --
+
+    def _apply_sentinel(self, epoch: int, m) -> None:
+        """React to the epoch's non-finite step count (the ``nonfinite``
+        metric total). Under ``skip`` the in-graph guard already discarded
+        the bad updates — this just counts and logs. Under ``rollback``,
+        once ``sentinel_budget`` consecutive bad steps accumulate, the
+        newest on-disk checkpoint is restored (a skipped update cannot
+        repair already-poisoned BN stats or escape a bad basin). On the
+        pipelined fit schedule totals arrive one epoch late, so a
+        rollback takes effect from the NEXT dispatch — bounded staleness,
+        same guarantee."""
+        if self.config.sentinel == "off":
+            return
+        bad = int(round(float(m.get("nonfinite", 0.0))))
+        if bad <= 0:
+            self._consec_bad = 0
+            return
+        self._consec_bad += bad
+        self.fault_stats["bad_steps"] += bad
+        log.warning(
+            "divergence sentinel: %d non-finite step(s) in epoch %d "
+            "skipped (%d consecutive, policy %s)",
+            bad, epoch, self._consec_bad, self.config.sentinel,
+        )
+        if (
+            self.config.sentinel == "rollback"
+            and self._consec_bad >= self.config.sentinel_budget
+        ):
+            self._rollback(epoch)
+
+    def _rollback(self, epoch: int) -> None:
+        """Restore the newest on-disk checkpoint over the live state."""
+        from pytorch_cifar_tpu.train.checkpoint import (
+            newest_checkpoint_order,
+        )
+
+        try:
+            state, _, _ = restore_checkpoint(
+                self.config.output_dir,
+                self.state,
+                names=newest_checkpoint_order(self.config.output_dir),
+            )
+        except FileNotFoundError:
+            log.warning(
+                "sentinel rollback requested at epoch %d but no usable "
+                "checkpoint exists; continuing with skipped updates", epoch
+            )
+            self._consec_bad = 0
+            return
+        self.state = replicate(state, self.mesh)
+        self._consec_bad = 0
+        self.fault_stats["rollbacks"] += 1
+        log.warning(
+            "divergence sentinel: rolled back to the last checkpoint "
+            "after %d consecutive non-finite steps (epoch %d)",
+            self.config.sentinel_budget, epoch,
+        )
 
     def train_epoch(self, epoch: int) -> Tuple[float, float]:
         if self.train_epoch_fn is not None:
@@ -481,6 +549,7 @@ class Trainer:
                         log_every=self.config.log_every,
                     )
         self.state = state
+        self._apply_sentinel(epoch, jax.device_get(totals))
         dt = time.time() - t0
         imgs = nb * self.global_batch
         log.info(
@@ -519,6 +588,7 @@ class Trainer:
         return totals
 
     def _log_train_totals(self, epoch, m, dt) -> Tuple[float, float]:
+        self._apply_sentinel(epoch, m)
         nb = self.steps_per_epoch
         loss_sum = float(m["loss_sum"])
         correct = float(m["correct"])
@@ -635,6 +705,7 @@ class Trainer:
                     self.state if snap_state is None else snap_state,
                     epoch,
                     self.best_acc,
+                    keep_last_n=self.config.keep_last_n,
                 )
                 return True
             self._snapshot = (
@@ -683,7 +754,8 @@ class Trainer:
             # the error instead of reporting a phantom checkpoint
             try:
                 save_checkpoint(
-                    self.config.output_dir, snap[0], snap[1], snap[2]
+                    self.config.output_dir, snap[0], snap[1], snap[2],
+                    keep_last_n=self.config.keep_last_n,
                 )
                 self._written_epoch = snap[1]
             except Exception:
@@ -705,7 +777,10 @@ class Trainer:
             t.join()
         snap = self._snapshot
         if snap is not None and snap[1] != self._written_epoch:
-            save_checkpoint(self.config.output_dir, snap[0], snap[1], snap[2])
+            save_checkpoint(
+                self.config.output_dir, snap[0], snap[1], snap[2],
+                keep_last_n=self.config.keep_last_n,
+            )
             self._written_epoch = snap[1]
 
     def fit(self) -> float:
@@ -807,6 +882,7 @@ class Trainer:
                         epoch,
                         self.best_acc,
                         name=LAST_NAME,
+                        keep_last_n=cfg.keep_last_n,
                     )
                     break
             else:

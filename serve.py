@@ -101,6 +101,9 @@ def main() -> int:
         max_batch=cfg.max_batch or None,
         max_wait_ms=cfg.max_wait_ms,
         max_queue=cfg.max_queue,
+        # fail-fast bound on queue time: an engine stall turns into
+        # DeadlineExceeded for queued callers instead of unbounded waits
+        default_deadline_ms=cfg.deadline_ms,
     )
     watcher = None
     if cfg.watch:
@@ -139,8 +142,11 @@ def main() -> int:
         "compiles": compiles_after,
         "engine_version": engine.version,
         "reloads": watcher.reloads if watcher is not None else 0,
+        "reload_skipped": watcher.skipped if watcher is not None else 0,
         "batches": batcher.stats["batches"],
         "largest_batch": batcher.stats["largest_batch"],
+        "deadline_ms": cfg.deadline_ms,
+        "expired": batcher.stats["expired"],
         **{
             k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in report.items()

@@ -60,7 +60,11 @@ SLOW_MODULES = {
     "test_multihost.py",     # real 2-process rendezvous, ~3 min
     "test_compat.py",        # state_dict round-trips, ~5 min with exporter
     "test_spatial.py",       # mesh exactness + HLO lowering, ~4 min
+    "test_chaos.py",         # subprocess kill/corrupt/resume drills, ~10 min
 }
+# fault-injection end-to-end drills (tools/chaos_run.py): `slow` AND
+# `chaos`, so `-m chaos` selects just the resilience suite
+CHAOS_MODULES = {"test_chaos.py"}
 SLOW_TESTS = {
     "test_parallel.py": (
         "test_graft_entry_dryrun_multichip",
@@ -99,6 +103,11 @@ def pytest_configure(config):
         "slow: integration-weight test excluded from the -m 'not slow' "
         "inner loop (full suite remains the CI gate)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection end-to-end drill (kill/corrupt/resume "
+        "through tools/chaos_run.py; ROBUSTNESS.md) — run with -m chaos",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
@@ -109,6 +118,8 @@ def pytest_collection_modifyitems(config, items):
             for p in SLOW_TESTS.get(fname, ())
         ):
             item.add_marker(pytest.mark.slow)
+        if fname in CHAOS_MODULES:
+            item.add_marker(pytest.mark.chaos)
 
 
 @pytest.fixture(scope="session")

@@ -84,12 +84,27 @@ def main() -> int:
     )
     eval_step = data_parallel_eval_step(make_eval_step(axis_name=DATA_AXIS), mesh)
 
-    if mode == "restore":
+    if mode in ("restore", "restore_fallback"):
         # cross-topology resume: restore a checkpoint that a DIFFERENT
         # mesh/process topology wrote. Checkpoints are host-side pytrees,
         # so the restore must be bit-exact regardless of the saving
         # topology; eval over the restored state pins the semantic.
-        state2, start_epoch, best_acc = restore_checkpoint(out_dir, state)
+        # "restore_fallback": restore through the resume candidate order —
+        # the test plants a CORRUPT newer preemption save, so process 0
+        # must fall back to ckpt.msgpack and broadcast that decision to
+        # every process (no host may diverge on which candidate won).
+        if mode == "restore_fallback":
+            from pytorch_cifar_tpu.train.checkpoint import (
+                newest_checkpoint_order,
+            )
+
+            state2, start_epoch, best_acc = restore_checkpoint(
+                out_dir, state, names=newest_checkpoint_order(out_dir)
+            )
+        else:
+            state2, start_epoch, best_acc = restore_checkpoint(
+                out_dir, state
+            )
         ev = jax.device_get(
             eval_step(state2, put_global(te_x, te_y, sharding))
         )

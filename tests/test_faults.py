@@ -1,0 +1,312 @@
+"""Fault-tolerance tier-1 tests (ROBUSTNESS.md contracts, all fast/CPU).
+
+What is pinned here:
+- checkpoint format v2: every save carries a CRC32/size manifest that
+  round-trips, and restore VERIFIES it;
+- fallback restore: a truncated or bit-flipped candidate falls back
+  through the order (and the rolling history) instead of crashing deep
+  inside flax deserialization; only zero usable candidates raises;
+- v1 compatibility: a manifest-less sidecar restores with a warning;
+- divergence sentinel: a NaN-poisoned step is skipped (params stay finite
+  and close to a fault-free run) and the rollback policy restores the
+  last checkpoint after the budget;
+- SIGTERM-style stop + resume reproduces the uninterrupted trajectory.
+
+The subprocess kill/corrupt drills live in test_chaos.py (slow, `chaos`
+marker); the serving-side fault tests (deadlines, torn-reload, engine
+fault containment) live in test_serve.py with the other serve contracts.
+"""
+
+import json
+import logging
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_cifar_tpu import faults
+from pytorch_cifar_tpu.config import TrainConfig
+from pytorch_cifar_tpu.train.checkpoint import (
+    CKPT_NAME,
+    LAST_NAME,
+    CheckpointCorrupt,
+    history_names,
+    meta_path,
+    newest_checkpoint_order,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from pytorch_cifar_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _lenet_state(seed=0):
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2)
+    return create_train_state(model, jax.random.PRNGKey(seed), tx)
+
+
+def _params_equal(a, b):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(jax.device_get(a)),
+        jax.tree_util.tree_leaves(jax.device_get(b)),
+    ):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def small_config(out_dir, **kw):
+    defaults = dict(
+        model="LeNet",
+        epochs=1,
+        batch_size=64,
+        eval_batch_size=64,
+        synthetic_data=True,
+        synthetic_train_size=256,
+        synthetic_test_size=128,
+        lr=0.02,
+        output_dir=str(out_dir),
+        amp=False,
+        log_every=1000,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+# -- checkpoint format v2: manifest + fsync'd atomic publish -------------
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    state = _lenet_state()
+    path = save_checkpoint(str(tmp_path), state, epoch=3, best_acc=42.0)
+    with open(meta_path(str(tmp_path), CKPT_NAME)) as f:
+        meta = json.load(f)
+    man = meta["manifest"]
+    assert man["format"] == 2
+    with open(path, "rb") as f:
+        payload = f.read()
+    assert man["size"] == len(payload)
+    assert man["crc32"] == (zlib.crc32(payload) & 0xFFFFFFFF)
+    # and no stray tmp file survived the atomic publish
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+    restored, start_epoch, best_acc = restore_checkpoint(
+        str(tmp_path), _lenet_state(seed=9)
+    )
+    assert start_epoch == 4 and best_acc == pytest.approx(42.0)
+    _params_equal(state.params, restored.params)
+
+
+@pytest.mark.parametrize("damage", ["truncate", "bitflip"])
+def test_corrupt_newest_falls_back_to_best_ckpt(tmp_path, damage, caplog):
+    """The acceptance drill: a damaged last.msgpack must make restore fall
+    back to ckpt.msgpack instead of raising (truncation = torn write,
+    bitflip = silent media corruption that still parses as msgpack)."""
+    best = _lenet_state(seed=0)
+    save_checkpoint(str(tmp_path), best, epoch=5, best_acc=50.0)
+    newer = _lenet_state(seed=7)
+    save_checkpoint(str(tmp_path), newer, epoch=7, best_acc=55.0,
+                    name=LAST_NAME)
+    victim = os.path.join(str(tmp_path), LAST_NAME)
+    if damage == "truncate":
+        faults.truncate_file(victim)
+    else:
+        faults.bitflip_file(victim)
+
+    order = newest_checkpoint_order(str(tmp_path))
+    assert order[0] == LAST_NAME  # the damaged file IS the preferred one
+    with caplog.at_level(logging.WARNING):
+        restored, start_epoch, best_acc = restore_checkpoint(
+            str(tmp_path), _lenet_state(seed=3), names=order
+        )
+    assert start_epoch == 6 and best_acc == pytest.approx(50.0)
+    _params_equal(best.params, restored.params)
+    assert any("corrupt" in r.message for r in caplog.records)
+
+
+def test_all_candidates_corrupt_raises_filenotfound(tmp_path):
+    save_checkpoint(str(tmp_path), _lenet_state(), epoch=1, best_acc=1.0)
+    save_checkpoint(
+        str(tmp_path), _lenet_state(), epoch=2, best_acc=2.0, name=LAST_NAME
+    )
+    for name in (CKPT_NAME, LAST_NAME):
+        faults.truncate_file(os.path.join(str(tmp_path), name))
+    with pytest.raises(FileNotFoundError, match="no usable checkpoint"):
+        restore_checkpoint(
+            str(tmp_path), _lenet_state(),
+            names=newest_checkpoint_order(str(tmp_path)),
+        )
+
+
+def test_v1_checkpoint_without_manifest_restores_with_warning(
+    tmp_path, caplog
+):
+    """Backward compatibility: pre-robustness sidecars carry no manifest;
+    they must keep restoring (unverified), loudly."""
+    state = _lenet_state()
+    save_checkpoint(str(tmp_path), state, epoch=2, best_acc=20.0)
+    mpath = meta_path(str(tmp_path), CKPT_NAME)
+    with open(mpath) as f:
+        meta = json.load(f)
+    del meta["manifest"]
+    with open(mpath, "w") as f:
+        json.dump(meta, f)
+    with caplog.at_level(logging.WARNING):
+        restored, start_epoch, best_acc = restore_checkpoint(
+            str(tmp_path), _lenet_state(seed=4)
+        )
+    assert start_epoch == 3 and best_acc == pytest.approx(20.0)
+    _params_equal(state.params, restored.params)
+    assert any("no manifest" in r.message for r in caplog.records)
+
+
+def test_history_rolls_and_serves_as_fallback(tmp_path):
+    """keep_last_n keeps prior checkpoint versions (separate inodes) and
+    prunes beyond N; a corrupt primary falls back to the newest copy."""
+    states = {e: _lenet_state(seed=e) for e in (1, 2, 3)}
+    for e in (1, 2, 3):
+        save_checkpoint(
+            str(tmp_path), states[e], epoch=e, best_acc=float(e),
+            keep_last_n=2,
+        )
+    hist = history_names(str(tmp_path), CKPT_NAME)
+    assert hist == ["ckpt-e00003.msgpack", "ckpt-e00002.msgpack"]  # e1 pruned
+    faults.bitflip_file(os.path.join(str(tmp_path), CKPT_NAME))
+    restored, start_epoch, best_acc = restore_checkpoint(
+        str(tmp_path), _lenet_state(seed=9)
+    )
+    # newest history copy wins: epoch 3, untouched by the primary's damage
+    assert start_epoch == 4 and best_acc == pytest.approx(3.0)
+    _params_equal(states[3].params, restored.params)
+
+
+# -- divergence sentinel -------------------------------------------------
+
+
+def test_nan_step_skipped_params_finite_and_close_to_clean(tmp_path):
+    """A NaN loss at one step under policy=skip must leave params finite
+    and within float32 tolerance of a run that never saw the fault (the
+    only legitimate delta is the one missing update; step counter/LR/rng
+    stay aligned)."""
+    clean = Trainer(small_config(tmp_path / "clean"))
+    clean.train_epoch(0)
+
+    faults.inject("nan_loss", 2)  # poison global step 2 (of 4 this epoch)
+    faulted = Trainer(small_config(tmp_path / "faulted"))
+    faulted.train_epoch(0)
+    assert faulted.fault_stats["bad_steps"] == 1
+
+    p_clean = jax.tree_util.tree_leaves(jax.device_get(clean.state.params))
+    p_fault = jax.tree_util.tree_leaves(jax.device_get(faulted.state.params))
+    deltas = []
+    for a, b in zip(p_clean, p_fault):
+        b = np.asarray(b)
+        assert np.isfinite(b).all()
+        deltas.append(np.max(np.abs(np.asarray(a) - b)))
+    assert max(deltas) < 0.05, f"skip diverged from clean run: {max(deltas)}"
+    # the step counter advanced over the skipped step (schedule alignment)
+    assert int(faulted.state.step) == int(clean.state.step)
+
+
+def test_nan_without_sentinel_poisons_params(tmp_path):
+    """Control for the test above: with the sentinel off, the same fault
+    propagates NaN into the params — the reference failure mode the
+    sentinel exists to stop."""
+    faults.inject("nan_loss", 2)
+    tr = Trainer(small_config(tmp_path, sentinel="off"))
+    tr.train_epoch(0)
+    leaves = jax.tree_util.tree_leaves(jax.device_get(tr.state.params))
+    assert any(not np.isfinite(np.asarray(p)).all() for p in leaves)
+
+
+def test_rollback_after_budget_restores_checkpoint(tmp_path):
+    """policy=rollback: after `sentinel_budget` consecutive bad steps the
+    trainer restores the newest on-disk checkpoint."""
+    # epoch 0 (steps 0-3) is clean; step 4 (epoch 1) is poisoned
+    faults.inject("nan_loss", 4)
+    tr = Trainer(
+        small_config(
+            tmp_path, epochs=2, sentinel="rollback", sentinel_budget=1
+        )
+    )
+    tr.train_epoch(0)
+    p0 = jax.device_get(tr.state.params)
+    _, acc = tr.eval_epoch(0)
+    assert tr.maybe_checkpoint(0, acc)
+    tr.flush_checkpoints()
+
+    tr.train_epoch(1)  # bad step 4 -> budget hit -> rollback to epoch 0
+    assert tr.fault_stats["bad_steps"] == 1
+    assert tr.fault_stats["rollbacks"] == 1
+    _params_equal(p0, tr.state.params)
+
+
+def test_rollback_without_checkpoint_logs_and_continues(tmp_path, caplog):
+    faults.inject("nan_loss", 0)
+    tr = Trainer(
+        small_config(tmp_path, sentinel="rollback", sentinel_budget=1)
+    )
+    with caplog.at_level(logging.WARNING):
+        tr.train_epoch(0)  # no checkpoint on disk yet
+    assert tr.fault_stats["rollbacks"] == 0
+    assert any("no usable checkpoint" in r.message for r in caplog.records)
+    leaves = jax.tree_util.tree_leaves(jax.device_get(tr.state.params))
+    assert all(np.isfinite(np.asarray(p)).all() for p in leaves)
+
+
+def test_invalid_sentinel_policy_rejected(tmp_path):
+    with pytest.raises(ValueError, match="sentinel"):
+        Trainer(small_config(tmp_path, sentinel="explode"))
+
+
+# -- preemption: stop + resume == uninterrupted --------------------------
+
+
+def test_sigterm_stop_resume_matches_uninterrupted(tmp_path):
+    """The in-process half of acceptance (c): a graceful stop after epoch
+    0 plus --resume must finish with the SAME best checkpoint (params and
+    metadata) as a never-interrupted run — per-epoch (seed, epoch) rng
+    keys make the resumed trajectory deterministic."""
+    cfg_a = small_config(tmp_path / "clean", epochs=3)
+    Trainer(cfg_a).fit()
+
+    cfg_b = small_config(tmp_path / "stopped", epochs=3)
+    tr = Trainer(cfg_b)
+    tr.request_stop()  # what the SIGTERM handler installed by fit() calls
+    tr.fit()  # stops after epoch 0, writes last.msgpack
+    assert os.path.isfile(os.path.join(cfg_b.output_dir, LAST_NAME))
+
+    tr2 = Trainer(small_config(tmp_path / "stopped", epochs=3, resume=True))
+    assert tr2.start_epoch == 1
+    tr2.fit()
+
+    from flax import serialization
+
+    def best_of(out_dir):
+        with open(os.path.join(out_dir, CKPT_NAME), "rb") as f:
+            tree = serialization.msgpack_restore(f.read())
+        with open(meta_path(out_dir, CKPT_NAME)) as f:
+            return tree["params"], json.load(f)
+
+    pa, ma = best_of(cfg_a.output_dir)
+    pb, mb = best_of(cfg_b.output_dir)
+    assert ma["epoch"] == mb["epoch"]
+    assert ma["best_acc"] == pytest.approx(mb["best_acc"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pa), jax.tree_util.tree_leaves(pb)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # completed resume removed the stale preemption save
+    assert not os.path.isfile(os.path.join(cfg_b.output_dir, LAST_NAME))

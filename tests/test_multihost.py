@@ -120,6 +120,38 @@ def test_cross_topology_checkpoint_resume(tmp_path):
     assert back["resumed_epoch"] == 2
 
 
+def test_multiprocess_corrupt_fallback_restore(tmp_path):
+    """Acceptance (a) on multiple processes: a corrupt newest checkpoint
+    makes restore fall back — and BOTH processes agree on the fallback
+    candidate via the process-0 broadcast, so neither raises or restores
+    a different file (which would diverge/deadlock the collective job)."""
+    out = str(tmp_path / "mh")
+    two = _run_workers(2, 4, out)  # process 0 wrote ckpt @ epoch 1
+
+    # plant a CORRUPT newer preemption save: sidecar (epoch 9, valid-shape
+    # manifest) pointing at garbage payload bytes — the resume order now
+    # prefers it, and only manifest verification can reject it
+    with open(os.path.join(out, "last.msgpack"), "wb") as f:
+        f.write(b"not a checkpoint")
+    with open(os.path.join(out, "last.json"), "w") as f:
+        json.dump(
+            {
+                "epoch": 9,
+                "best_acc": 99.0,
+                "manifest": {"format": 2, "crc32": 1, "size": 496812},
+            },
+            f,
+        )
+
+    restored = _run_workers(2, 4, out, extra_args=("restore_fallback",))
+    for r in restored:
+        # fell back to ckpt (epoch 1 -> resume at 2), NOT the corrupt
+        # epoch-9 save; best_acc comes from the fallback's sidecar
+        assert r["resumed_epoch"] == 2
+        assert r["best_acc"] == pytest.approx(12.5)
+        assert r["psum"] == pytest.approx(two[0]["psum"], rel=1e-12)
+
+
 @pytest.mark.parametrize("spatial", [2, 4])
 def test_two_process_spatial_matches_single_process(tmp_path, spatial):
     """Multi-host spatial partitioning (VERDICT round-1 weak 5): a full

@@ -198,6 +198,135 @@ def test_close_drains_admitted_requests_then_rejects(lenet_engine):
         b.submit(_images(1))
 
 
+# -- deadlines + fail-fast shutdown (ROBUSTNESS.md) ----------------------
+
+
+class _StubEngine:
+    """Engine stand-in for batcher-only contracts: shape-correct logits,
+    optional per-call latency (stall simulation), no jax involved."""
+
+    buckets = (8,)
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def predict(self, x):
+        self.calls += 1
+        if self.delay_s:
+            import time
+
+            time.sleep(self.delay_s)
+        return np.zeros((x.shape[0], 10), np.float32)
+
+
+def test_expired_request_fails_fast_not_batched():
+    """A request whose deadline passes while queued fails with
+    DeadlineExceeded at batch-formation time and never occupies a
+    coalesced batch; unexpired requests in the same queue still serve."""
+    import time
+
+    from pytorch_cifar_tpu.serve import DeadlineExceeded, MicroBatcher
+
+    eng = _StubEngine()
+    b = MicroBatcher(
+        eng, max_batch=4, max_wait_ms=0, max_queue=64, autostart=False
+    )
+    doomed = b.submit(_images(2), deadline_ms=5)
+    alive = b.submit(_images(1))  # no deadline
+    time.sleep(0.05)  # let the deadline lapse while the worker is down
+    b.start()
+    assert alive.result(timeout=60).shape == (1, 10)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=60)
+    b.close()
+    assert b.stats["expired"] == 1
+    # the expired request's rows never reached the engine
+    assert b.stats["images"] == 1
+
+
+def test_default_deadline_from_constructor():
+    import time
+
+    from pytorch_cifar_tpu.serve import DeadlineExceeded, MicroBatcher
+
+    b = MicroBatcher(
+        _StubEngine(), max_batch=4, max_wait_ms=0, max_queue=64,
+        default_deadline_ms=5, autostart=False,
+    )
+    fut = b.submit(_images(1))
+    time.sleep(0.05)
+    b.start()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=60)
+    b.close()
+
+
+def test_close_without_drain_fails_pending_immediately():
+    """close(drain=False) must fail every pending future synchronously —
+    even when the worker thread never ran at all — so no caller is left
+    blocked forever on future.result()."""
+    from pytorch_cifar_tpu.serve import BatcherClosed, MicroBatcher
+
+    b = MicroBatcher(
+        _StubEngine(), max_batch=4, max_wait_ms=0, max_queue=64,
+        autostart=False,  # the worker is NEVER started: worst case
+    )
+    futs = [b.submit(_images(1, seed=i)) for i in range(3)]
+    b.close(drain=False)
+    for f in futs:
+        with pytest.raises(BatcherClosed):
+            f.result(timeout=1)
+    with pytest.raises(BatcherClosed):
+        b.submit(_images(1))
+
+
+def test_close_join_timeout_fails_stranded_requests():
+    """A worker wedged inside a stalled engine call must not strand the
+    rest of the queue: close(timeout=...) that misses the join fails the
+    still-queued futures; the in-flight batch completes on its own."""
+    import time
+
+    from pytorch_cifar_tpu.serve import BatcherClosed, MicroBatcher
+
+    eng = _StubEngine(delay_s=0.5)  # every batch stalls half a second
+    b = MicroBatcher(
+        eng, max_batch=1, max_wait_ms=0, max_queue=64, autostart=False
+    )
+    in_flight = b.submit(_images(1))
+    stranded = b.submit(_images(1, seed=1))
+    b.start()
+    time.sleep(0.1)  # worker is now inside the stalled predict(in_flight)
+    b.close(drain=True, timeout=0.05)  # join times out
+    with pytest.raises(BatcherClosed, match="timed out"):
+        stranded.result(timeout=1)
+    # the batch the engine already held completes normally
+    assert in_flight.result(timeout=10).shape == (1, 10)
+
+
+def test_engine_fault_fails_only_its_batch(lenet_engine):
+    """An injected engine failure propagates to exactly the coalesced
+    batch that hit it; the batcher and later requests keep working."""
+    from pytorch_cifar_tpu import faults
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=0, max_queue=64,
+        autostart=False,
+    )
+    faults.inject("serve_error", times=1)
+    try:
+        doomed = b.submit(_images(1))
+        b.start()
+        with pytest.raises(RuntimeError, match="injected fault"):
+            doomed.result(timeout=60)
+        # the very next request serves normally
+        assert b.predict(_images(1)).shape == (1, 10)
+    finally:
+        faults.clear()
+        b.close()
+
+
 # -- checkpoint loading + hot reload ------------------------------------
 
 
@@ -291,6 +420,49 @@ def test_hot_reload_swaps_mid_stream(tmp_path):
     assert np.array_equal(after, eng.direct_forward(x))
     # unchanged file -> no spurious reload
     assert watcher.poll_once() is False and eng.version == 1
+
+
+def test_watcher_never_serves_torn_checkpoint(tmp_path):
+    """A checkpoint whose payload no longer matches its sidecar manifest
+    (torn write, or a payload/sidecar pair from two different publishes)
+    must be skipped — the engine keeps serving its current weights — and
+    picked up once a complete publish lands (ROBUSTNESS.md)."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu import faults
+    from pytorch_cifar_tpu.serve import CheckpointWatcher, InferenceEngine
+
+    _save_lenet_checkpoint(tmp_path, seed=0, epoch=1, best_acc=10.0)
+    eng = InferenceEngine.from_checkpoint(
+        str(tmp_path), "LeNet", buckets=(1,), compute_dtype=jnp.float32
+    )
+    watcher = CheckpointWatcher(eng, str(tmp_path), poll_s=3600)
+    x = _images(2)
+    before = eng.predict(x)
+
+    # in-place damage changes mtime (signature) but not the sidecar:
+    # exactly what a reader sees mid-publish or after bit rot
+    faults.bitflip_file(os.path.join(str(tmp_path), "ckpt.msgpack"))
+    assert watcher.poll_once() is False
+    assert watcher.skipped == 1 and eng.version == 0
+    assert np.array_equal(eng.predict(x), before)  # still serving old
+
+    # a complete publish repairs the pair; the next poll swaps
+    _save_lenet_checkpoint(tmp_path, seed=5, epoch=2, best_acc=20.0)
+    assert watcher.poll_once() is True
+    assert eng.version == 1 and watcher.last_meta["epoch"] == 2
+
+
+def test_load_checkpoint_trees_rejects_corrupt_payload(tmp_path):
+    from pytorch_cifar_tpu import faults
+    from pytorch_cifar_tpu.serve.engine import load_checkpoint_trees
+    from pytorch_cifar_tpu.train.checkpoint import CheckpointCorrupt
+
+    _save_lenet_checkpoint(tmp_path, seed=0, epoch=1, best_acc=10.0)
+    path = os.path.join(str(tmp_path), "ckpt.msgpack")
+    faults.truncate_file(path)
+    with pytest.raises(CheckpointCorrupt, match="truncated"):
+        load_checkpoint_trees(path, "LeNet")
 
 
 def test_swap_rejects_mismatched_weights(tmp_path):

@@ -1,0 +1,315 @@
+#!/usr/bin/env python3
+"""Chaos harness: kill/corrupt/NaN-inject a real training run, then prove
+it recovers (ROBUSTNESS.md has the failure model this exercises).
+
+Each mode runs an UNINTERRUPTED reference training run and a CHAOS run of
+the same config into separate directories, asserts the chaos run ends in
+the same place, and prints ONE JSON line with the verdict + recovery time:
+
+  sigterm  — preemption drill: SIGTERM mid-epoch -> the trainer finishes
+             the epoch, writes last.msgpack, exits; --resume completes the
+             run. Final params/metadata must match the reference run.
+  sigkill  — crash drill: SIGKILL mid-epoch (no goodbye write); --resume
+             restores the newest usable checkpoint and re-runs the lost
+             epochs. Deterministic per-epoch rng makes the final state
+             match the reference run.
+  corrupt  — torn-write drill: like sigterm, but the preemption save is
+             truncated (or bit-flipped, --corruption bitflip) before the
+             relaunch; the manifest-verified restore must FALL BACK to the
+             best-params checkpoint and still complete.
+  nan      — divergence drill: PCT_FAULTS=nan_loss=K poisons the loss at
+             one step under --sentinel skip; the run must finish finite
+             and land within float32 tolerance of the reference run.
+
+Usage:
+  python tools/chaos_run.py --mode sigterm
+  python tools/chaos_run.py --mode corrupt --corruption bitflip
+  python tools/chaos_run.py --mode nan --epochs 3
+
+Subprocess-only: this driver never initializes a jax backend (the child
+runs own the device); comparisons read the msgpack checkpoints directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def train_cmd(args, out_dir: str, resume: bool = False):
+    cmd = [
+        sys.executable, os.path.join(REPO, "train.py"),
+        "--model", args.model,
+        "--synthetic_data",
+        "--synthetic_train_size", str(args.train_size),
+        "--synthetic_test_size", str(args.test_size),
+        "--batch_size", str(args.batch),
+        "--epochs", str(args.epochs),
+        "--lr", str(args.lr),
+        "--no-amp",
+        "--output_dir", out_dir,
+        "--log_every", "1000000",
+        "--seed", str(args.seed),
+        "--sentinel", args.sentinel,
+    ]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def child_env(extra=None):
+    env = dict(os.environ)
+    # chaos drills run on CPU unless the caller explicitly targets a chip:
+    # the point is the recovery logic, not device throughput
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra or {})
+    return env
+
+
+def run_to_completion(cmd, env, timeout) -> float:
+    t0 = time.monotonic()
+    r = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO,
+    )
+    if r.returncode != 0:
+        sys.stderr.write(r.stdout[-2000:] + "\n" + r.stderr[-4000:] + "\n")
+        raise SystemExit(f"child failed rc={r.returncode}: {cmd}")
+    return time.monotonic() - t0
+
+
+def wait_for_checkpoint(out_dir: str, proc, timeout: float) -> None:
+    """Block until the run has published its first best checkpoint (both
+    payload and sidecar) — the precondition for a recoverable kill."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out, err = proc.communicate()
+            raise SystemExit(
+                f"training exited rc={proc.returncode} before its first "
+                f"checkpoint:\n{err[-4000:]}"
+            )
+        if all(
+            os.path.isfile(os.path.join(out_dir, n))
+            for n in ("ckpt.msgpack", "ckpt.json")
+        ):
+            return
+        time.sleep(0.2)
+    proc.kill()
+    raise SystemExit("timed out waiting for the first checkpoint")
+
+
+def interrupt_run(args, out_dir: str, sig) -> int:
+    """Launch training, let it publish a checkpoint, then signal it
+    mid-run. Returns the child's exit code."""
+    proc = subprocess.Popen(
+        train_cmd(args, out_dir),
+        env=child_env(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+    )
+    wait_for_checkpoint(out_dir, proc, args.timeout)
+    time.sleep(args.kill_delay_s)  # land inside a later epoch, not the save
+    if proc.poll() is None:
+        proc.send_signal(sig)
+    try:
+        proc.communicate(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise SystemExit(f"child ignored signal {sig}")
+    return proc.returncode
+
+
+def _leaves(tree, out):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            _leaves(tree[k], out)
+    else:
+        out.append(np.asarray(tree))
+    return out
+
+
+def load_params(out_dir: str):
+    from flax import serialization
+
+    with open(os.path.join(out_dir, "ckpt.msgpack"), "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    return _leaves(tree["params"], [])
+
+
+def load_meta(out_dir: str) -> dict:
+    with open(os.path.join(out_dir, "ckpt.json")) as f:
+        return json.load(f)
+
+
+def compare(dir_a: str, dir_b: str) -> dict:
+    a, b = load_params(dir_a), load_params(dir_b)
+    assert len(a) == len(b), (len(a), len(b))
+    max_diff = 0.0
+    finite = True
+    for x, y in zip(a, b):
+        finite &= bool(np.isfinite(y).all())
+        d = np.abs(x.astype(np.float64) - y.astype(np.float64))
+        # NaN anywhere counts as infinite divergence: Python's max() would
+        # silently keep the old value (nan comparisons are False)
+        d = np.where(np.isnan(d), np.inf, d)
+        max_diff = max(max_diff, float(np.max(d)))
+    ma, mb = load_meta(dir_a), load_meta(dir_b)
+    return {
+        "max_abs_diff": max_diff,
+        "finite": finite,
+        "best_epoch_ref": ma.get("epoch"),
+        "best_epoch_chaos": mb.get("epoch"),
+        "best_acc_ref": ma.get("best_acc"),
+        "best_acc_chaos": mb.get("best_acc"),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--mode", choices=("sigterm", "sigkill", "corrupt", "nan"),
+        default="sigterm",
+    )
+    p.add_argument(
+        "--corruption", choices=("truncate", "bitflip"), default="truncate",
+        help="how --mode corrupt damages the preemption save",
+    )
+    p.add_argument("--model", default="LeNet")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--train-size", type=int, default=512, dest="train_size")
+    p.add_argument("--test-size", type=int, default=256, dest="test_size")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sentinel", default="skip")
+    p.add_argument(
+        "--nan-step", type=int, default=2, dest="nan_step",
+        help="global step the nan mode poisons (PCT_FAULTS=nan_loss=K)",
+    )
+    p.add_argument(
+        "--kill-delay-s", type=float, default=0.5, dest="kill_delay_s",
+        help="seconds past the first checkpoint before the signal lands",
+    )
+    p.add_argument(
+        "--tol", type=float, default=None,
+        help="max |param diff| vs the reference run (default: 1e-6 for "
+        "kill/corrupt modes — same deterministic trajectory re-run — and "
+        "0.25 for nan, where one update is legitimately skipped)",
+    )
+    p.add_argument("--timeout", type=float, default=900)
+    p.add_argument(
+        "--out", default=None,
+        help="work dir (default: a fresh temp dir, removed on success)",
+    )
+    args = p.parse_args()
+    tol = args.tol if args.tol is not None else (
+        0.25 if args.mode == "nan" else 1e-6
+    )
+
+    work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
+    dir_ref = os.path.join(work, "reference")
+    dir_chaos = os.path.join(work, "chaos")
+
+    print(f"==> [{args.mode}] reference run -> {dir_ref}", file=sys.stderr)
+    ref_s = run_to_completion(
+        train_cmd(args, dir_ref), child_env(), args.timeout
+    )
+
+    interrupted = None
+    recovery_s = 0.0
+    if args.mode == "nan":
+        print(
+            f"==> [{args.mode}] faulted run (nan_loss={args.nan_step}, "
+            f"sentinel={args.sentinel}) -> {dir_chaos}", file=sys.stderr,
+        )
+        recovery_s = run_to_completion(
+            train_cmd(args, dir_chaos),
+            child_env({"PCT_FAULTS": f"nan_loss={args.nan_step}"}),
+            args.timeout,
+        )
+    else:
+        sig = signal.SIGKILL if args.mode == "sigkill" else signal.SIGTERM
+        print(
+            f"==> [{args.mode}] interrupted run -> {dir_chaos}",
+            file=sys.stderr,
+        )
+        rc = interrupt_run(args, dir_chaos, sig)
+        interrupted = {"signal": int(sig), "rc": rc}
+        if args.mode in ("sigterm", "corrupt") and rc != 0:
+            raise SystemExit(f"SIGTERM run did not exit cleanly (rc={rc})")
+        if args.mode == "corrupt":
+            import glob as _glob
+
+            from pytorch_cifar_tpu import faults
+
+            # damage the preemption save AND its rolling-history copies so
+            # the restore must fall all the way back to ckpt.msgpack (the
+            # acceptance drill); when the run completed before the signal
+            # landed there is no last.msgpack — damage the best checkpoint
+            # primary instead and let its history serve the fallback
+            victims = _glob.glob(os.path.join(dir_chaos, "last*.msgpack"))
+            if not victims:
+                victims = [os.path.join(dir_chaos, "ckpt.msgpack")]
+            for victim in victims:
+                if args.corruption == "truncate":
+                    faults.truncate_file(victim)
+                else:
+                    faults.bitflip_file(victim)
+                print(
+                    f"==> [{args.mode}] {args.corruption}d {victim}",
+                    file=sys.stderr,
+                )
+        print(f"==> [{args.mode}] resuming {dir_chaos}", file=sys.stderr)
+        recovery_s = run_to_completion(
+            train_cmd(args, dir_chaos, resume=True), child_env(), args.timeout
+        )
+
+    cmp = compare(dir_ref, dir_chaos)
+    ok = (
+        cmp["finite"]
+        and cmp["max_abs_diff"] <= tol
+        and cmp["best_epoch_ref"] == cmp["best_epoch_chaos"]
+        and abs(cmp["best_acc_ref"] - cmp["best_acc_chaos"])
+        <= (2.0 if args.mode == "nan" else 1e-6)
+    )
+    record = {
+        "harness": "chaos_run",
+        "mode": args.mode,
+        "match": ok,
+        "tol": tol,
+        "reference_s": round(ref_s, 2),
+        "recovery_s": round(recovery_s, 2),
+        **{k: (round(v, 8) if isinstance(v, float) else v)
+           for k, v in cmp.items()},
+    }
+    if args.mode == "corrupt":
+        record["corruption"] = args.corruption
+    if interrupted:
+        record.update(interrupted)
+    print(json.dumps(record))
+    if ok and not args.out:
+        import shutil
+
+        shutil.rmtree(work, ignore_errors=True)
+    elif not ok:
+        print(f"==> artifacts kept in {work}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

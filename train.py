@@ -20,6 +20,15 @@ def main(argv=None) -> float:
     config = parse_config(argv)
     trainer = Trainer(config)  # installs the logger (primary process only)
     best = trainer.fit()
+    stats = trainer.fault_stats
+    if stats["bad_steps"] or stats["rollbacks"]:
+        # surfaced on the CLI, not only in the log: a run that survived
+        # divergence should say so where the operator is looking
+        print(
+            f"divergence sentinel: {stats['bad_steps']} non-finite "
+            f"step(s) handled, {stats['rollbacks']} rollback(s) "
+            f"(policy {config.sentinel})"
+        )
     print(f"best test accuracy: {best:.2f}%")
     return best
 
