@@ -195,6 +195,19 @@ def run_eval(
     return best
 
 
+def step_time_obs(registry, input_wait_frac: float = 0.0) -> dict:
+    """The bench record's ``obs`` block (train side): step-time p50/p95
+    from the registry's ``train.step_time_ms`` histogram plus the
+    input-wait fraction — the input-bound-vs-compute-bound verdict the
+    totals alone cannot give (OBSERVABILITY.md)."""
+    s = registry.summary()
+    return {
+        "step_time_p50_ms": round(s.get("train.step_time_ms.p50", 0.0), 3),
+        "step_time_p95_ms": round(s.get("train.step_time_ms.p95", 0.0), 3),
+        "input_wait_frac": round(input_wait_frac, 4),
+    }
+
+
 def run_one(
     model: str, batch: int, steps: int, warmup: int, compute_dtype,
     repeats: int = 1,
@@ -225,6 +238,9 @@ def run_one(
     # img/s across identical runs), not device variance — the fastest block
     # is the closest estimate of actual chip throughput
     best = 0.0
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
     for _ in range(max(repeats, 1)):
         t0 = time.perf_counter()
         for i in range(steps):
@@ -233,8 +249,14 @@ def run_one(
         elapsed = time.perf_counter() - t0
         loss = loss_sum / float(metrics["count"])
         assert np.isfinite(loss), f"non-finite loss {loss} for {model}"
+        # one step-time sample per measurement block (per-step timing
+        # would need a per-step sync, which is the dispatch stall this
+        # protocol exists to avoid)
+        reg.histogram("train.step_time_ms").observe(elapsed * 1e3 / steps)
         best = max(best, steps * batch / elapsed)
-    return best
+    # input wait is structurally zero here: batches are pre-staged on
+    # device before the timed window
+    return best, step_time_obs(reg, input_wait_frac=0.0)
 
 
 def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
@@ -277,10 +299,18 @@ def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
             # mesh spans every local chip and would report mesh throughput
             num_devices=1,
         )
+        from pytorch_cifar_tpu.obs import MetricsRegistry
+
         trainer = Trainer(cfg)
         trainer.train_epoch(0)  # compiles + one-time dataset staging
         best = 0.0
         epoch = 1
+        steps_per_epoch = trainer.steps_per_epoch
+        # a bench-local registry, NOT trainer.obs: the warmup epoch above
+        # already recorded its compile-inflated step time there, and the
+        # obs block must describe the measured windows only
+        reg = MetricsRegistry()
+        step_hist = reg.histogram("train.step_time_ms")
         for _ in range(max(repeats, 1)):
             t0 = time.perf_counter()
             totals = None
@@ -291,8 +321,24 @@ def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
             dt = time.perf_counter() - t0
             loss = float(m["loss_sum"]) / max(float(m["count"]), 1)
             assert np.isfinite(loss), f"non-finite epoch loss for {model}"
+            # window-derived step time into the trainer's own registry so
+            # the obs block reports the measured windows, not the compile-
+            # heavy warmup epoch
+            step_hist.observe(dt * 1e3 / (window * steps_per_epoch))
             best = max(best, window * n_train / dt)
-    return best
+        # input-wait fraction from the trainer's registry: structurally
+        # ~zero on the device-resident data plane (only the host-loader
+        # step loop accrues train.input_wait_s), which is exactly the
+        # input-bound verdict the block exists to report
+        s = trainer.obs.summary()
+        wait_frac = (
+            s.get("train.input_wait_s", 0.0)
+            / max(s.get("train.epoch_s", 0.0), 1e-9)
+            if s.get("train.epoch_s", 0.0)
+            else 0.0
+        )
+        obs = step_time_obs(reg, input_wait_frac=wait_frac)
+    return best, obs
 
 
 def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
@@ -374,6 +420,17 @@ def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
         "serving bench recompiled after warmup"
     )
     report["max_batch"] = max_b
+    # serving-side obs block from the batcher's registry (queue pressure
+    # and expiry health ride the same single-line record as throughput)
+    s = batcher.obs.summary()
+    report["obs"] = {
+        "queue_depth_max": s.get("serve.queue_depth.max", 0.0),
+        "deadline_expired": s.get("serve.expired", 0.0),
+        "batch_occupancy_mean": round(
+            s.get("serve.batch_occupancy.mean", 0.0), 4
+        ),
+        "latency_p95_ms": round(s.get("serve.latency_ms.p95", 0.0), 3),
+    }
     return report
 
 
@@ -542,11 +599,12 @@ def headline(args) -> int:
             raise SystemExit(1)
         return rec
 
-    captures, metric = [], None
+    captures, records, metric = [], [], None
     for i in range(max(args.captures, 1)):
         rec = run_child(["--epoch"])
         metric = rec["metric"]
         captures.append(rec["value"])
+        records.append(rec)
         # no "/N" denominator: a CPU smoke stops after one capture, so the
         # planned count would mislead anyone tailing the log
         print(
@@ -559,6 +617,11 @@ def headline(args) -> int:
     value = statistics.median(captures)
     out = core_record(metric, value)
     out["captures"] = [round(c, 2) for c in captures]
+    # obs block of the capture closest to the published median (an average
+    # across captures would mix percentiles from different processes)
+    nearest = min(records, key=lambda r: abs(r["value"] - value))
+    if "obs" in nearest:
+        out["obs"] = nearest["obs"]
     out["spread_pct"] = round(
         (max(captures) - min(captures)) / value * 100, 2
     ) if len(captures) > 1 else 0.0
@@ -681,6 +744,7 @@ def main() -> int:
             requests=report["requests"],
             rejected=report["rejected"],
             clients=report["clients"],
+            obs=report["obs"],
         )
         name = f"serve_throughput_{args.model}_b{report['max_batch']}"
     elif args.config is not None:
@@ -690,7 +754,7 @@ def main() -> int:
             run_one(
                 m, batch, args.steps, args.warmup, compute_dtype,
                 repeats=args.repeats,
-            )
+            )[0]
             for m in models
         ]
         # one number per config: geometric mean across its models
@@ -703,18 +767,20 @@ def main() -> int:
         )
         name = f"eval_throughput_{args.model}_b{args.batch}"
     elif args.epoch:
-        value = run_epoch(
+        value, obs = run_epoch(
             args.model, args.batch, compute_dtype, repeats=args.repeats
         )
+        extra = {"obs": obs}
         name = f"epoch_throughput_{args.model}_b{args.batch}"
     else:
         # The jitted step runs on a single device (default placement, no
         # sharding), so per-chip throughput == measured throughput
         # regardless of how many chips the host exposes.
-        value = run_one(
+        value, obs = run_one(
             args.model, args.batch, args.steps, args.warmup, compute_dtype,
             repeats=args.repeats,
         )
+        extra = {"obs": obs}
         name = f"train_throughput_{args.model}_b{args.batch}"
 
     if not args.pipeline:

@@ -150,6 +150,21 @@ class TrainConfig:
     sentinel: str = "skip"
     sentinel_budget: int = 3
 
+    # Observability (OBSERVABILITY.md) — all OFF by default; the hot path
+    # pays only a no-op function call per instrumentation site when off.
+    #   trace_out: write host-side spans (epoch/step/dispatch/checkpoint/
+    #   data-wait) as Chrome/Perfetto trace-event JSON to this file; open
+    #   in ui.perfetto.dev or fold with tools/trace_summary.py. Spans also
+    #   nest jax.profiler.TraceAnnotation (when this jaxlib has it) so a
+    #   --profile device capture lines host spans up with XLA activity.
+    trace_out: str = ""
+    #   metrics_out: append periodic registry snapshots (counters/gauges/
+    #   histograms: step+epoch timing, input-wait, checkpoint IO, sentinel
+    #   events) as JSONL to this file, every metrics_every_s seconds, plus
+    #   one final line at exit.
+    metrics_out: str = ""
+    metrics_every_s: float = 10.0
+
     # misc
     seed: int = 0
     log_every: int = 50
@@ -203,6 +218,15 @@ class ServeConfig:
     # verify bit-identity of the padded bucket path against a direct
     # unpadded jitted forward before serving (one extra compile)
     verify: bool = False
+
+    # observability (OBSERVABILITY.md): host-span trace file, periodic
+    # JSONL metrics (queue depth, batch occupancy, admission-to-completion
+    # latency, expiries, reloads), and a Prometheus text dump written at
+    # exit (the scrape-file convention; there is no HTTP frontend yet)
+    trace_out: str = ""
+    metrics_out: str = ""
+    metrics_every_s: float = 10.0
+    prom_out: str = ""
 
 
 def _add_args(parser: argparse.ArgumentParser, cls=TrainConfig) -> None:

@@ -25,12 +25,14 @@ shards via ``jax.make_array_from_process_local_data`` — a plain
 from __future__ import annotations
 
 import collections
+import time
 from typing import Iterator, Optional, Tuple
 
 import jax
 import numpy as np
 
 from pytorch_cifar_tpu.native import augment_batch_u8, gather_batch
+from pytorch_cifar_tpu.obs import trace
 
 
 def local_slab(
@@ -101,6 +103,7 @@ class Dataloader:
         host_augment: bool = False,
         augment_padding: int = 4,
         augment_flip: bool = True,
+        registry=None,
     ):
         assert images.shape[0] == labels.shape[0]
         # normalize once so the native gather's zero-copy fast path applies
@@ -134,6 +137,16 @@ class Dataloader:
         self.host_augment = host_augment
         self.augment_padding = augment_padding
         self.augment_flip = augment_flip
+        # observability (obs/, OBSERVABILITY.md): per-batch host production
+        # cost (gather + augment + put dispatch). Input-bound detection is
+        # the ratio of this against device step time — the Trainer records
+        # its own wait-side histogram (train.input_wait_ms) and bench folds
+        # both into the obs block. None = zero-cost (one is-None check).
+        self._obs_hist = (
+            registry.histogram("data.host_batch_ms")
+            if registry is not None
+            else None
+        )
 
     def __len__(self) -> int:
         n = self.images.shape[0]
@@ -174,6 +187,7 @@ class Dataloader:
 
         def host_batches():
             for b in range(nb):
+                t0 = time.perf_counter()
                 lo = b * self.batch_size + r0
                 hi = lo + local_bs
                 if hi <= n and lo < n:
@@ -209,6 +223,10 @@ class Dataloader:
                     # AFTER augmentation (crops move pixels across shard
                     # boundaries, so the full image must exist first)
                     x = np.ascontiguousarray(x[:, h0:h1])
+                if self._obs_hist is not None:
+                    self._obs_hist.observe(
+                        (time.perf_counter() - t0) * 1e3
+                    )
                 yield x, y
 
         # double-buffer: keep `prefetch` batches in flight on device
@@ -405,12 +423,13 @@ class DeviceDataset:
         permutation forever."""
         if not self.shuffle:
             return self._perm_static
-        if self.device_perm:
-            return self._device_perm_fn(np.int32(epoch))
-        order = np.random.RandomState(
-            (self.seed * 100003 + epoch) % (2**31)
-        ).permutation(self.n)
-        return self._put_perm(self._epoch_perm(order))
+        with trace.span("data/staged_perm", epoch=epoch):
+            if self.device_perm:
+                return self._device_perm_fn(np.int32(epoch))
+            order = np.random.RandomState(
+                (self.seed * 100003 + epoch) % (2**31)
+            ).permutation(self.n)
+            return self._put_perm(self._epoch_perm(order))
 
     def epoch(self, epoch: int) -> Iterator[Tuple[jax.Array, jax.Array]]:
         perm = self.staged_perm(epoch)

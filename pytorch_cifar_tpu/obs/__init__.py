@@ -1,0 +1,36 @@
+"""Observability substrate: metrics registry, trace spans, exporters.
+
+One instrumentation layer for every subsystem (train / serve / data /
+checkpoint / faults) instead of the per-PR one-offs it replaces
+(``trainer.fault_stats``, the batcher's ``stats`` dict — both survive as
+thin views over the registry). Three pieces:
+
+- :mod:`~pytorch_cifar_tpu.obs.metrics` — process-local, thread-safe
+  counters / gauges / fixed-bucket histograms whose snapshots are plain
+  JSON-serializable pytrees, so they cross-host merge through the same
+  collective helpers the checkpoint broadcast uses and summarize
+  deterministically (no unordered iteration anywhere);
+- :mod:`~pytorch_cifar_tpu.obs.trace` — host-side span API (context
+  manager + instant events) emitting Chrome/Perfetto trace-event JSON,
+  nesting ``jax.profiler.TraceAnnotation`` when the installed jaxlib has
+  it so host spans line up with XLA device activity;
+- :mod:`~pytorch_cifar_tpu.obs.export` — periodic JSONL emitter, an
+  end-of-run summary, and a Prometheus-text dump for the serving path.
+
+Everything is OFF by default and near-zero-cost when off: an uninstalled
+tracer makes ``trace.span`` return one shared no-op context manager, and
+no exporter thread exists unless a CLI flag asked for one (pinned by
+tests/test_obs.py). See OBSERVABILITY.md for metric names and the span
+naming convention.
+"""
+
+from pytorch_cifar_tpu.obs.metrics import (  # noqa: F401
+    MetricsRegistry,
+    merge_snapshots,
+    summarize,
+)
+from pytorch_cifar_tpu.obs import trace  # noqa: F401
+from pytorch_cifar_tpu.obs.export import (  # noqa: F401
+    MetricsExporter,
+    prometheus_text,
+)

@@ -19,6 +19,25 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 
 
+def _distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` with a jaxlib 0.4.36-era
+    fallback: the public predicate landed after 0.4.x, where the only
+    signal is the private global client handle. Same version-gap pattern
+    as the shard_map shim in parallel/dp.py — an older jax must degrade
+    to the equivalent check, never AttributeError (this took down every
+    multihost worker in the 0.4.37 container). Must not force backend
+    initialization (see initialize_distributed's NB)."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -37,7 +56,7 @@ def initialize_distributed(
     ``jax.distributed.initialize`` is permanently too late (the process
     would silently run single-host with its local devices only).
     """
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized():
         return
     try:
         jax.distributed.initialize(

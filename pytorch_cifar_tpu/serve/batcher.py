@@ -40,6 +40,8 @@ from typing import Optional
 
 import numpy as np
 
+from pytorch_cifar_tpu.obs import MetricsRegistry, trace
+
 
 class QueueFull(RuntimeError):
     """Admission control: the request queue is at max_queue images."""
@@ -54,13 +56,14 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("x", "n", "future", "expires_at")
+    __slots__ = ("x", "n", "future", "expires_at", "admitted_at")
 
     def __init__(self, x: np.ndarray, expires_at: Optional[float] = None):
         self.x = x
         self.n = x.shape[0]
         self.future: Future = Future()
         self.expires_at = expires_at  # time.monotonic() deadline, or None
+        self.admitted_at = 0.0  # perf_counter at admission (latency obs)
 
 
 class MicroBatcher:
@@ -73,6 +76,7 @@ class MicroBatcher:
         max_queue: int = 1024,
         default_deadline_ms: float = 0.0,
         autostart: bool = True,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.engine = engine
         self.max_batch = int(max_batch or max(engine.buckets))
@@ -90,17 +94,46 @@ class MicroBatcher:
         self._closed = False
         self._drain = True
         self._thread: Optional[threading.Thread] = None
-        # observability for tests and the CLIs
-        self.stats = {
-            "requests": 0,
-            "images": 0,
-            "batches": 0,
-            "rejected": 0,
-            "expired": 0,
-            "largest_batch": 0,
-        }
+        # observability (obs/, OBSERVABILITY.md): the registry is the
+        # single source of truth — PR 1's ad-hoc ``stats`` dict survives
+        # as the read-only view below. ``registry=None`` gives this
+        # batcher its own (tests assert exact counts); the serve CLI
+        # passes one shared registry through engine+batcher+watcher so
+        # the exporter sees the whole serving process.
+        self.obs = registry if registry is not None else MetricsRegistry()
+        self._c_requests = self.obs.counter("serve.requests")
+        self._c_images = self.obs.counter("serve.images")
+        self._c_batches = self.obs.counter("serve.batches")
+        self._c_rejected = self.obs.counter("serve.rejected")
+        self._c_expired = self.obs.counter("serve.expired")
+        self._g_queue = self.obs.gauge("serve.queue_depth")
+        # images per coalesced batch (its max is the old largest_batch)
+        # and fill fraction against max_batch — the knob max_wait_ms
+        # exists to move
+        self._h_batch = self.obs.histogram(
+            "serve.batch_images",
+            bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self._h_occupancy = self.obs.histogram(
+            "serve.batch_occupancy",
+            bounds=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
+        # admission -> result latency, the client-observed number
+        self._h_latency = self.obs.histogram("serve.latency_ms")
         if autostart:
             self.start()
+
+    @property
+    def stats(self) -> dict:
+        """Back-compat view over the registry (the PR 1 ``stats`` keys)."""
+        return {
+            "requests": int(self._c_requests.value),
+            "images": int(self._c_images.value),
+            "batches": int(self._c_batches.value),
+            "rejected": int(self._c_rejected.value),
+            "expired": int(self._c_expired.value),
+            "largest_batch": int(self._h_batch.snapshot()["max"]),
+        }
 
     # -- client side ---------------------------------------------------
 
@@ -124,14 +157,16 @@ class MicroBatcher:
             if self._closed:
                 raise BatcherClosed("batcher is closed")
             if self._queued_images + req.n > self.max_queue:
-                self.stats["rejected"] += 1
+                self._c_rejected.inc()
                 raise QueueFull(
                     f"queue at {self._queued_images}/{self.max_queue} "
                     f"images; retry later"
                 )
+            req.admitted_at = time.perf_counter()
             self._q.append(req)
             self._queued_images += req.n
-            self.stats["requests"] += 1
+            self._c_requests.inc()
+            self._g_queue.set(self._queued_images)
             self._cond.notify()
         return req.future
 
@@ -160,7 +195,7 @@ class MicroBatcher:
         for req in self._q:
             if req.expires_at is not None and now >= req.expires_at:
                 self._queued_images -= req.n
-                self.stats["expired"] += 1
+                self._c_expired.inc()
                 req.future.set_exception(
                     DeadlineExceeded(
                         f"request expired after "
@@ -171,6 +206,7 @@ class MicroBatcher:
             else:
                 kept.append(req)
         self._q = kept
+        self._g_queue.set(self._queued_images)
 
     def _take_batch(self):
         """Block until work exists, then coalesce up to max_batch images,
@@ -196,7 +232,7 @@ class MicroBatcher:
                         # expired while coalescing: fail it, keep going
                         self._q.popleft()
                         self._queued_images -= head.n
-                        self.stats["expired"] += 1
+                        self._c_expired.inc()
                         head.future.set_exception(
                             DeadlineExceeded(
                                 "request deadline passed while queued"
@@ -219,11 +255,11 @@ class MicroBatcher:
                     if not self._q:
                         break  # timeout or spurious wake with no work
             self._queued_images -= total
-            self.stats["batches"] += 1
-            self.stats["images"] += total
-            self.stats["largest_batch"] = max(
-                self.stats["largest_batch"], total
-            )
+            self._g_queue.set(self._queued_images)
+            self._c_batches.inc()
+            self._c_images.inc(total)
+            self._h_batch.observe(total)
+            self._h_occupancy.observe(total / self.max_batch)
         return batch
 
     def _worker(self) -> None:
@@ -243,15 +279,18 @@ class MicroBatcher:
                 else np.concatenate([r.x for r in batch], axis=0)
             )
             try:
-                out = self.engine.predict(x)
+                with trace.span("serve/batch", images=int(x.shape[0])):
+                    out = self.engine.predict(x)
             except Exception as e:  # engine failure fails THIS batch only
                 for req in batch:
                     req.future.set_exception(e)
                 continue
             off = 0
+            done = time.perf_counter()
             for req in batch:
                 req.future.set_result(out[off : off + req.n])
                 off += req.n
+                self._h_latency.observe((done - req.admitted_at) * 1e3)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -260,6 +299,7 @@ class MicroBatcher:
             req = self._q.popleft()
             self._queued_images -= req.n
             req.future.set_exception(exc)
+        self._g_queue.set(self._queued_images)
 
     def close(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop accepting requests; by default finish everything already

@@ -27,11 +27,13 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
 from pytorch_cifar_tpu import faults
+from pytorch_cifar_tpu.obs import trace
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)
 
@@ -150,6 +152,7 @@ class InferenceEngine:
         std: Optional[Sequence[float]] = None,
         image_shape: Tuple[int, int, int] = (32, 32, 3),
         warmup: bool = True,
+        registry=None,
     ):
         import jax.numpy as jnp
 
@@ -200,6 +203,16 @@ class InferenceEngine:
         self._swap_lock = threading.Lock()
         self.compile_count = 0  # bucket compiles only (see warmup)
         self.version = 0  # bumped by every swap_weights
+        # observability (obs/): device-time histogram per executable call
+        # — against the batcher's admission-to-completion latency this
+        # splits queue wait from device time. Optional: None costs one
+        # is-None check per predict.
+        self._obs = registry
+        self._h_device = (
+            registry.histogram("serve.device_ms")
+            if registry is not None
+            else None
+        )
         self._set_weights(params, batch_stats)
         if warmup:
             self.warmup()
@@ -260,10 +273,13 @@ class InferenceEngine:
             if b in self._compiled:
                 continue
             x = jnp.zeros((b, *self.image_shape), jnp.uint8)
-            self._compiled[b] = (
-                jax.jit(self._fwd).lower(params, stats, x).compile()
-            )
+            with trace.span("serve/compile_bucket", bucket=b):
+                self._compiled[b] = (
+                    jax.jit(self._fwd).lower(params, stats, x).compile()
+                )
             self.compile_count += 1
+            if self._obs is not None:
+                self._obs.counter("serve.compiles").inc()
 
     def bucket_for(self, n: int) -> int:
         """Smallest bucket >= n, or the largest bucket (callers chunk)."""
@@ -282,8 +298,13 @@ class InferenceEngine:
             pad = np.zeros((b - n, *self.image_shape), x.dtype)
             x = np.concatenate([x, pad], axis=0)
         params, stats = self._weights  # atomic tuple read
-        out = self._compiled[b](params, stats, x)
-        return np.asarray(out)[:n]
+        t0 = time.perf_counter()
+        with trace.span("serve/bucket_forward", bucket=b, n=n):
+            out = self._compiled[b](params, stats, x)
+            res = np.asarray(out)[:n]  # D2H: waits for the execution
+        if self._h_device is not None:
+            self._h_device.observe((time.perf_counter() - t0) * 1e3)
+        return res
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         """uint8 NHWC batch of any size -> fp32 logits ``(n, classes)``."""

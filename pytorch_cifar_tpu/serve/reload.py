@@ -48,6 +48,7 @@ class CheckpointWatcher:
         *,
         name: str = CKPT_NAME,
         poll_s: float = 1.0,
+        registry=None,
     ):
         self.engine = engine
         self.ckpt_dir = ckpt_dir
@@ -59,6 +60,10 @@ class CheckpointWatcher:
         # checkpoint will be picked up complete on a later poll)
         self.skipped = 0
         self.last_meta: dict = {}
+        # obs registry (optional): the counters mirror the attributes
+        # above so the serving exporter/Prometheus dump carries reload
+        # health without callers polling watcher attributes
+        self._obs = registry
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # baseline signature: whatever is on disk NOW is what the engine
@@ -86,7 +91,12 @@ class CheckpointWatcher:
         sig = self._signature()
         if sig is None or sig == self._last_sig:
             return False
+        from pytorch_cifar_tpu.obs import trace
         from pytorch_cifar_tpu.serve.engine import load_checkpoint_trees
+
+        def count(event):
+            if self._obs is not None:
+                self._obs.counter(f"serve.reload.{event}").inc()
 
         try:
             params, stats, meta = load_checkpoint_trees(
@@ -102,6 +112,7 @@ class CheckpointWatcher:
             # file just keeps being skipped, never served
             log.warning("skipping torn/corrupt checkpoint: %s", e)
             self.skipped += 1
+            count("skipped")
             return False
         except Exception:
             # unreadable for a non-integrity reason (e.g. deleted mid
@@ -109,6 +120,7 @@ class CheckpointWatcher:
             # isn't re-read every poll
             log.exception("checkpoint reload failed (%s)", self._path())
             self.errors += 1
+            count("errors")
             self._last_sig = sig
             return False
         if self._signature() != sig:
@@ -121,6 +133,7 @@ class CheckpointWatcher:
                 "poll", self._path(),
             )
             self.skipped += 1
+            count("skipped")
             return False
         try:
             version = self.engine.swap_weights(params, stats)
@@ -129,11 +142,16 @@ class CheckpointWatcher:
             # remember the signature so it isn't re-tried every poll
             log.exception("checkpoint swap rejected (%s)", self._path())
             self.errors += 1
+            count("errors")
             self._last_sig = sig
             return False
         self._last_sig = sig
         self.last_meta = meta
         self.reloads += 1
+        count("reloads")
+        trace.instant(
+            "serve/hot_reload", version=version, path=self._path()
+        )
         log.info(
             "hot-reloaded %s -> engine version %d (meta %s)",
             self._path(),

@@ -26,6 +26,7 @@ import json
 import logging
 import os
 import re
+import time
 import zlib
 from typing import Any, Optional, Sequence, Tuple
 
@@ -33,6 +34,7 @@ import jax
 import numpy as np
 from flax import serialization
 
+from pytorch_cifar_tpu.obs import trace
 from pytorch_cifar_tpu.train.state import TrainState
 
 log = logging.getLogger(__name__)
@@ -179,37 +181,58 @@ def save_checkpoint(
     best_acc: float,
     name: str = CKPT_NAME,
     keep_last_n: int = 0,
+    registry=None,
 ) -> Optional[str]:
     """Write state to ``output_dir`` (process 0 only). Returns the path.
 
     Write order is part of the format: payload first, sidecar (carrying
     the payload's manifest) second — a reader that verifies the manifest
     therefore never trusts a payload/sidecar pairing from two different
-    publishes (serve/reload.py gates its hot swap on exactly this)."""
+    publishes (serve/reload.py gates its hot swap on exactly this).
+
+    ``registry`` (obs.MetricsRegistry, optional): records duration and
+    payload bytes — through a serialized host link the device_get below is
+    the dominant cost of a save, and without a number it gets blamed on
+    the training step it stalls (OBSERVABILITY.md)."""
     if jax.process_index() != 0:
         return None
-    os.makedirs(output_dir, exist_ok=True)
-    # one logical copy on host; works for replicated or single-device state
-    host_state = jax.device_get(
-        {
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "opt_state": state.opt_state,
-            "step": state.step,
-        }
-    )
-    payload = serialization.to_bytes(host_state)
-    path = os.path.join(output_dir, name)
-    _atomic_write(path, payload)
+    t0 = time.perf_counter()
+    with trace.span("checkpoint/save", file=name, epoch=int(epoch)):
+        os.makedirs(output_dir, exist_ok=True)
+        # one logical copy on host; works for replicated or single-device
+        # state
+        with trace.span("checkpoint/device_get"):
+            host_state = jax.device_get(
+                {
+                    "params": state.params,
+                    "batch_stats": state.batch_stats,
+                    "opt_state": state.opt_state,
+                    "step": state.step,
+                }
+            )
+        payload = serialization.to_bytes(host_state)
+        path = os.path.join(output_dir, name)
+        with trace.span("checkpoint/write", bytes=len(payload)):
+            _atomic_write(path, payload)
 
-    meta = {
-        "epoch": int(epoch),
-        "best_acc": float(best_acc),
-        "manifest": payload_manifest(payload),
-    }
-    _atomic_write(meta_path(output_dir, name), json.dumps(meta).encode())
-    if keep_last_n > 0:
-        _update_history(output_dir, name, epoch, payload, meta, keep_last_n)
+            meta = {
+                "epoch": int(epoch),
+                "best_acc": float(best_acc),
+                "manifest": payload_manifest(payload),
+            }
+            _atomic_write(
+                meta_path(output_dir, name), json.dumps(meta).encode()
+            )
+            if keep_last_n > 0:
+                _update_history(
+                    output_dir, name, epoch, payload, meta, keep_last_n
+                )
+    if registry is not None:
+        registry.counter("checkpoint.saves").inc()
+        registry.counter("checkpoint.saved_bytes").inc(len(payload))
+        registry.histogram("checkpoint.save_ms").observe(
+            (time.perf_counter() - t0) * 1e3
+        )
     return path
 
 
@@ -290,6 +313,7 @@ def restore_checkpoint(
     state: TrainState,
     name: str = CKPT_NAME,
     names: Optional[Sequence[str]] = None,
+    registry=None,
 ) -> Tuple[TrainState, int, float]:
     """Load ``output_dir``'s checkpoint into ``state``'s structure.
 
@@ -303,6 +327,7 @@ def restore_checkpoint(
     Returns (state, start_epoch, best_acc); start_epoch is the next epoch
     to run (saved epoch + 1).
     """
+    t0 = time.perf_counter()
     candidates = list(names) if names is not None else [name]
     multihost = jax.process_count() > 1
     if multihost:
@@ -329,9 +354,10 @@ def restore_checkpoint(
             expanded.extend(history_names(output_dir, cand))
         for cand in expanded:
             try:
-                restored, epoch, best_acc = _read_verified(
-                    output_dir, cand, target
-                )
+                with trace.span("checkpoint/restore", file=cand):
+                    restored, epoch, best_acc = _read_verified(
+                        output_dir, cand, target
+                    )
             except FileNotFoundError:
                 continue
             except CheckpointCorrupt as e:
@@ -339,6 +365,9 @@ def restore_checkpoint(
                     "checkpoint candidate %s is corrupt (%s); "
                     "falling back", cand, e
                 )
+                if registry is not None:
+                    registry.counter("checkpoint.corrupt_candidates").inc()
+                trace.instant("checkpoint/corrupt_candidate", file=cand)
                 continue
             if cand != expanded[0]:
                 log.warning(
@@ -346,6 +375,8 @@ def restore_checkpoint(
                     "preferred candidate was missing or corrupt",
                     cand, epoch,
                 )
+                if registry is not None:
+                    registry.counter("checkpoint.fallbacks").inc()
             break
     have_ckpt = restored is not None
     if multihost:
@@ -374,4 +405,9 @@ def restore_checkpoint(
         opt_state=restored["opt_state"],
         step=restored["step"],
     )
+    if registry is not None:
+        registry.counter("checkpoint.restores").inc()
+        registry.histogram("checkpoint.restore_ms").observe(
+            (time.perf_counter() - t0) * 1e3
+        )
     return state, epoch + 1, best_acc

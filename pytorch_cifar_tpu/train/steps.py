@@ -210,16 +210,25 @@ def make_train_step(
     return step
 
 
-def zero_metrics() -> Metrics:
+def zero_metrics(num_steps: int = 0) -> Metrics:
     """Initial value for the on-device running metric sums. DISTINCT
     arrays: the epoch fns donate this argument, and aliasing one buffer
-    across leaves trips XLA's donate-same-buffer-twice check."""
-    return {
+    across leaves trips XLA's donate-same-buffer-twice check.
+
+    ``num_steps > 0`` adds a ``nonfinite_steps`` vector (one 0/1 slot per
+    scan step) for the epoch-compiled path: the sentinel's per-step
+    bad-step attribution (which steps were skipped, not just how many —
+    the ROADMAP item the per-epoch total could not answer). Scalar-only
+    callers (the per-step loop, eval) keep the old shape."""
+    m = {
         "loss_sum": jnp.zeros((), jnp.float32),
         "correct": jnp.zeros((), jnp.float32),
         "count": jnp.zeros((), jnp.float32),
         "nonfinite": jnp.zeros((), jnp.float32),
     }
+    if num_steps > 0:
+        m["nonfinite_steps"] = jnp.zeros((num_steps,), jnp.float32)
+    return m
 
 
 def make_train_epoch(
@@ -323,7 +332,20 @@ def make_train_epoch(
                 x = jax.lax.with_sharding_constraint(x, batch_sharding)
                 y = jax.lax.with_sharding_constraint(y, label_sharding)
             state, metrics = step(state, (x, y), rng)
-            totals = jax.tree_util.tree_map(jnp.add, totals, metrics)
+            if "nonfinite_steps" in totals:
+                # per-step attribution rides the carry, not the running
+                # sums: slot i records THIS step's replica-agreed 0/1
+                # verdict (metrics["nonfinite"] is exactly 0/1 per step),
+                # so the fetched epoch totals say WHICH steps the sentinel
+                # skipped, not just how many
+                totals = dict(totals)
+                mask = totals.pop("nonfinite_steps")
+                totals = jax.tree_util.tree_map(jnp.add, totals, metrics)
+                totals["nonfinite_steps"] = mask.at[i].set(
+                    metrics["nonfinite"]
+                )
+            else:
+                totals = jax.tree_util.tree_map(jnp.add, totals, metrics)
             return (state, totals), None
 
         (state, totals), _ = jax.lax.scan(

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from pytorch_cifar_tpu.config import TrainConfig
 from pytorch_cifar_tpu.data.cifar10 import load_cifar10, synthetic_cifar10
+from pytorch_cifar_tpu.obs import MetricsExporter, MetricsRegistry, trace
 from pytorch_cifar_tpu.data.pipeline import (
     Dataloader,
     DeviceDataset,
@@ -81,10 +82,26 @@ class Trainer:
         set_dense_grouped_conv(config.dense_grouped_conv)
         if config.distributed:
             initialize_distributed()
-        if is_primary():
-            set_logger(
-                f"{config.output_dir}/train.log" if config.output_dir else None
-            )
+        # rank-aware logging: every rank gets its OWN file handler (a
+        # straggler-host post-mortem needs that rank's epoch lines), but
+        # non-zero ranks console-log at WARNING — N identical epoch lines
+        # interleaved on one terminal help nobody (utils/logging.py)
+        pidx = jax.process_index()
+        log_name = "train.log" if pidx == 0 else f"train.rank{pidx}.log"
+        set_logger(
+            f"{config.output_dir}/{log_name}" if config.output_dir else None,
+            process_index=pidx,
+        )
+
+        # observability (obs/, OBSERVABILITY.md): per-Trainer registry —
+        # components own their registries so tests and concurrent Trainers
+        # never share counters; CLIs read trainer.obs for export/summary.
+        # Metric mutation is always on (it is a lock + float add); the
+        # exporter thread and the tracer only exist when flags ask.
+        self.obs = MetricsRegistry()
+        self._exporter = None
+        if config.trace_out:
+            trace.install(config.trace_out)
 
         # -- data ------------------------------------------------------
         if config.synthetic_data:
@@ -212,6 +229,7 @@ class Trainer:
                 label_sharding=lbl_sharding,
                 host_augment=host_aug,
                 augment_flip=config.random_flip,
+                registry=self.obs,
             )
             self.steps_per_epoch = len(self.loader)
         # eval data stays device-resident too: the test set is static, so
@@ -269,7 +287,7 @@ class Trainer:
                 else self._resume_order(config.output_dir)
             )
             state, self.start_epoch, self.best_acc = restore_checkpoint(
-                config.output_dir, state, names=names
+                config.output_dir, state, names=names, registry=self.obs
             )
             log.info(
                 "resumed from %s: epoch %d, best_acc %.2f",
@@ -406,11 +424,31 @@ class Trainer:
         self._save_thread = None
         self._written_epoch = None
         # divergence-sentinel policy state (ROBUSTNESS.md): consecutive
-        # non-finite-step counter + observable totals for tests/CLIs
+        # non-finite-step counter; totals live in the obs registry now
+        # (fault_stats below is a read view over it) and per-step
+        # attribution accumulates in _bad_step_indices
         self._consec_bad = 0
-        self.fault_stats = {"bad_steps": 0, "rollbacks": 0}
+        self._bad_step_indices: list = []
 
     # ------------------------------------------------------------------
+
+    @property
+    def fault_stats(self) -> dict:
+        """Back-compat view of the sentinel totals (PR 2's ad-hoc dict,
+        folded into the obs registry — single source of truth; the keys
+        existing callers/tests read are preserved). ``bad_step_indices``
+        lists the GLOBAL step index of every skipped update the
+        epoch-compiled path attributed (per-step mask in the epoch totals,
+        steps.zero_metrics)."""
+        return {
+            "bad_steps": int(
+                self.obs.counter("train.sentinel.bad_steps").value
+            ),
+            "rollbacks": int(
+                self.obs.counter("train.sentinel.rollbacks").value
+            ),
+            "bad_step_indices": list(self._bad_step_indices),
+        }
 
     @staticmethod
     def _resume_order(output_dir: str):
@@ -440,11 +478,32 @@ class Trainer:
             self._consec_bad = 0
             return
         self._consec_bad += bad
-        self.fault_stats["bad_steps"] += bad
+        self.obs.counter("train.sentinel.bad_steps").inc(bad)
+        # per-step attribution (closes the ROADMAP "sentinel telemetry"
+        # item): the epoch-compiled scan carries a 0/1 slot per step
+        # (steps.make_train_epoch), so the log can name WHICH global steps
+        # were skipped — rollback/debug granularity of one step, not one
+        # epoch. The per-step host loop has no mask (each step's metric is
+        # fetched individually there, so attribution was never lost).
+        import numpy as np
+
+        mask = m.get("nonfinite_steps")
+        bad_steps: list = []
+        if mask is not None:
+            base = epoch * self.steps_per_epoch
+            bad_steps = [
+                base + int(i) for i in np.nonzero(np.asarray(mask) > 0)[0]
+            ]
+            self._bad_step_indices.extend(bad_steps)
+            trace.instant(
+                "train/sentinel_skip", epoch=epoch, steps=bad_steps
+            )
         log.warning(
             "divergence sentinel: %d non-finite step(s) in epoch %d "
-            "skipped (%d consecutive, policy %s)",
-            bad, epoch, self._consec_bad, self.config.sentinel,
+            "skipped%s (%d consecutive, policy %s)",
+            bad, epoch,
+            f" at global step(s) {bad_steps}" if bad_steps else "",
+            self._consec_bad, self.config.sentinel,
         )
         if (
             self.config.sentinel == "rollback"
@@ -463,6 +522,7 @@ class Trainer:
                 self.config.output_dir,
                 self.state,
                 names=newest_checkpoint_order(self.config.output_dir),
+                registry=self.obs,
             )
         except FileNotFoundError:
             log.warning(
@@ -473,12 +533,32 @@ class Trainer:
             return
         self.state = replicate(state, self.mesh)
         self._consec_bad = 0
-        self.fault_stats["rollbacks"] += 1
+        self.obs.counter("train.sentinel.rollbacks").inc()
+        trace.instant("train/sentinel_rollback", epoch=epoch)
         log.warning(
             "divergence sentinel: rolled back to the last checkpoint "
             "after %d consecutive non-finite steps (epoch %d)",
             self.config.sentinel_budget, epoch,
         )
+
+    def _timed_batches(self, iterable):
+        """Iterate ``iterable`` measuring the host's wait for each batch —
+        the input-bound signal: when ``train.input_wait_ms`` rivals step
+        time, the pipeline (not the chip) bounds throughput. Near-free:
+        two perf_counter reads per batch."""
+        wait_hist = self.obs.histogram("train.input_wait_ms")
+        wait_total = self.obs.counter("train.input_wait_s")
+        it = iter(iterable)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            dt = time.perf_counter() - t0
+            wait_hist.observe(dt * 1e3)
+            wait_total.inc(dt)
+            yield batch
 
     def train_epoch(self, epoch: int) -> Tuple[float, float]:
         if self.train_epoch_fn is not None:
@@ -499,10 +579,17 @@ class Trainer:
         t0 = time.time()
         tty = sys.stdout.isatty()
         last_sync = 0.0  # wall-clock of the last TTY metric fetch
-        for i, batch in enumerate(self.loader.epoch(epoch)):
+        epoch_span = trace.span("train/epoch", epoch=epoch, path="step_loop")
+        epoch_span.__enter__()
+        for i, batch in enumerate(
+            self._timed_batches(self.loader.epoch(epoch))
+        ):
             if trace_end and i == 0:
                 jax.profiler.start_trace(self._trace_dir)
-            state, metrics = self.train_step(state, batch, rng)
+            with trace.span("train/step", step=i):
+                # the span times DISPATCH (execution is async) — exactly
+                # the host-side cost the per-step path exists to hide
+                state, metrics = self.train_step(state, batch, rng)
             if trace_end and i + 1 == trace_end:
                 jax.device_get(metrics)  # drain the async queue into the trace
                 jax.profiler.stop_trace()
@@ -550,7 +637,9 @@ class Trainer:
                     )
         self.state = state
         self._apply_sentinel(epoch, jax.device_get(totals))
+        epoch_span.__exit__(None, None, None)
         dt = time.time() - t0
+        self._record_epoch_timing(dt, nb)
         imgs = nb * self.global_batch
         log.info(
             "train epoch %d: loss %.4f acc %.2f%% (%.0f img/s)",
@@ -560,6 +649,18 @@ class Trainer:
             imgs / max(dt, 1e-9),
         )
         return loss_sum / max(count, 1), 100.0 * correct / max(count, 1)
+
+    def _record_epoch_timing(self, dt: float, nb: int) -> None:
+        """One epoch's wall time into the registry: epoch and derived
+        per-step histograms (the step-time p50/p95 the bench obs block
+        reports) plus the running epoch-seconds total that anchors the
+        input-wait fraction (input_wait_s / epoch_s)."""
+        self.obs.counter("train.epochs").inc()
+        self.obs.counter("train.epoch_s").inc(dt)
+        self.obs.histogram("train.epoch_ms").observe(dt * 1e3)
+        self.obs.histogram("train.step_time_ms").observe(
+            dt * 1e3 / max(nb, 1)
+        )
 
     def _dispatch_train_epoch(self, epoch: int):
         """Enqueue one whole-epoch computation; return the totals future.
@@ -576,20 +677,25 @@ class Trainer:
                 "Trainer was built with evaluate=True; training is disabled"
             )
         rng = jax.random.fold_in(self.rng, epoch)
-        perm = self.loader.staged_perm(epoch)
-        self.state, totals = self.train_epoch_fn(
-            self.state,
-            zero_metrics(),
-            self.loader.images,
-            self.loader.labels,
-            perm,
-            rng,
-        )
+        with trace.span("train/dispatch", epoch=epoch):
+            perm = self.loader.staged_perm(epoch)
+            # num_steps adds the per-step nonfinite mask to the carried
+            # totals: the sentinel's per-step attribution on the one-
+            # dispatch path (steps.zero_metrics)
+            self.state, totals = self.train_epoch_fn(
+                self.state,
+                zero_metrics(num_steps=self.steps_per_epoch),
+                self.loader.images,
+                self.loader.labels,
+                perm,
+                rng,
+            )
         return totals
 
     def _log_train_totals(self, epoch, m, dt) -> Tuple[float, float]:
         self._apply_sentinel(epoch, m)
         nb = self.steps_per_epoch
+        self._record_epoch_timing(dt, nb)
         loss_sum = float(m["loss_sum"])
         correct = float(m["correct"])
         count = float(m["count"])
@@ -622,12 +728,14 @@ class Trainer:
         (~1.4 s for the flagship)."""
         log.info("\nEpoch: %d", epoch)
         t0 = time.time()
-        if self._trace_dir:
-            jax.profiler.start_trace(self._trace_dir)
-        totals = self._dispatch_train_epoch(epoch)
-        m = jax.device_get(totals)  # the one sync of the epoch
-        if self._trace_dir:
-            jax.profiler.stop_trace()
+        with trace.span("train/epoch", epoch=epoch, path="epoch_compiled"):
+            if self._trace_dir:
+                jax.profiler.start_trace(self._trace_dir)
+            totals = self._dispatch_train_epoch(epoch)
+            with trace.span("train/fetch", epoch=epoch):
+                m = jax.device_get(totals)  # the one sync of the epoch
+            if self._trace_dir:
+                jax.profiler.stop_trace()
         return self._log_train_totals(epoch, m, time.time() - t0)
 
     def eval_epoch(self, epoch: int) -> Tuple[float, float]:
@@ -637,23 +745,26 @@ class Trainer:
         # same trap), which through a remote-TPU transport dominates the
         # eval epoch. All batches dispatch async; the single fetch at the
         # end drains the queue.
-        if self.eval_epoch_fn is not None:
-            # device-resident test set, whole eval in one dispatch: zero
-            # H2D per epoch, one D2H metric fetch
-            m = jax.device_get(self._dispatch_eval_epoch())
-        else:
-            totals = None
-            for x, y in eval_batches(
-                self.test_images, self.test_labels, self.eval_bs
-            ):
-                batch = put_global(x, y, self.sharding, self.label_sharding)
-                mm = self.eval_step(self.state, batch)
-                totals = (
-                    mm
-                    if totals is None
-                    else jax.tree_util.tree_map(jnp.add, totals, mm)
-                )
-            m = jax.device_get(totals)
+        with trace.span("eval/epoch", epoch=epoch):
+            if self.eval_epoch_fn is not None:
+                # device-resident test set, whole eval in one dispatch:
+                # zero H2D per epoch, one D2H metric fetch
+                m = jax.device_get(self._dispatch_eval_epoch())
+            else:
+                totals = None
+                for x, y in eval_batches(
+                    self.test_images, self.test_labels, self.eval_bs
+                ):
+                    batch = put_global(
+                        x, y, self.sharding, self.label_sharding
+                    )
+                    mm = self.eval_step(self.state, batch)
+                    totals = (
+                        mm
+                        if totals is None
+                        else jax.tree_util.tree_map(jnp.add, totals, mm)
+                    )
+                m = jax.device_get(totals)
         return self._log_eval_totals(epoch, m)
 
     def _log_eval_totals(self, epoch, m) -> Tuple[float, float]:
@@ -706,6 +817,7 @@ class Trainer:
                     epoch,
                     self.best_acc,
                     keep_last_n=self.config.keep_last_n,
+                    registry=self.obs,
                 )
                 return True
             self._snapshot = (
@@ -756,6 +868,7 @@ class Trainer:
                 save_checkpoint(
                     self.config.output_dir, snap[0], snap[1], snap[2],
                     keep_last_n=self.config.keep_last_n,
+                    registry=self.obs,
                 )
                 self._written_epoch = snap[1]
             except Exception:
@@ -780,6 +893,7 @@ class Trainer:
             save_checkpoint(
                 self.config.output_dir, snap[0], snap[1], snap[2],
                 keep_last_n=self.config.keep_last_n,
+                registry=self.obs,
             )
             self._written_epoch = snap[1]
 
@@ -792,8 +906,23 @@ class Trainer:
             self.global_batch,
             self.steps_per_epoch,
         )
+        if cfg.metrics_out:
+            # per-rank JSONL (ranks hold distinct registries; one shared
+            # file would interleave lines from N processes)
+            pidx = jax.process_index()
+            mpath = (
+                cfg.metrics_out
+                if pidx == 0
+                else f"{cfg.metrics_out}.rank{pidx}"
+            )
+            self._exporter = MetricsExporter(
+                self.obs, mpath, interval_s=cfg.metrics_every_s
+            ).start()
         if cfg.evaluate:
-            _, acc = self.eval_epoch(max(self.start_epoch - 1, 0))
+            try:
+                _, acc = self.eval_epoch(max(self.start_epoch - 1, 0))
+            finally:
+                self._close_obs()
             return acc
         # trace a bounded window of the second epoch (steady state, no compile
         # events) — or of the only epoch when just one runs. The reference has
@@ -836,7 +965,8 @@ class Trainer:
         def finish(p):
             nonlocal last_mark
             epoch_, tr_totals, ev_totals, snap = p
-            m = jax.device_get(tr_totals)
+            with trace.span("train/fetch", epoch=epoch_):
+                m = jax.device_get(tr_totals)
             now = time.time()
             self._log_train_totals(epoch_, m, now - last_mark)
             last_mark = now
@@ -883,6 +1013,7 @@ class Trainer:
                         self.best_acc,
                         name=LAST_NAME,
                         keep_last_n=cfg.keep_last_n,
+                        registry=self.obs,
                     )
                     break
             else:
@@ -910,9 +1041,20 @@ class Trainer:
             # the newest best-state snapshot must be on disk before the
             # process can exit (async writer, maybe_checkpoint)
             self.flush_checkpoints()
+            self._close_obs()
             if old_handler is not None:
                 signal.signal(signal.SIGTERM, old_handler)
         return self.best_acc
+
+    def _close_obs(self) -> None:
+        """Stop the metrics exporter (writing a final snapshot line) and
+        flush the trace file — a crashed/stopped run must still leave a
+        valid trace of everything before the stop."""
+        if self._exporter is not None:
+            self._exporter.stop()
+            self._exporter = None
+        if self.config.trace_out:
+            trace.flush()
 
     def _agreed_stop(self) -> bool:
         """Multi-host agreement on the stop flag: the per-process SIGTERM

@@ -1,5 +1,5 @@
 """File + console logging (parity: utils.py:128-141, installed
-main_dist.py:88)."""
+main_dist.py:88), rank-aware under multihost."""
 
 from __future__ import annotations
 
@@ -8,19 +8,34 @@ import os
 from typing import Optional
 
 
-def set_logger(log_path: Optional[str] = None) -> logging.Logger:
+def set_logger(
+    log_path: Optional[str] = None, process_index: int = 0
+) -> logging.Logger:
     """Configure the root logger with a console handler and, when
-    ``log_path`` is given, a file handler. Idempotent."""
+    ``log_path`` is given, a file handler. Idempotent.
+
+    ``process_index``: under multihost SPMD every rank runs the same epoch
+    loop, so an unfiltered console would print every epoch line N times
+    interleaved. Non-zero ranks keep their console at WARNING (problems
+    still surface, narration does not) while the file handler — callers
+    pass a rank-distinct ``log_path`` — records everything, so a per-rank
+    post-mortem loses nothing. Re-calling with a different index adjusts
+    the existing console handler (idempotency must not freeze the first
+    caller's rank).
+    """
     logger = logging.getLogger()
     logger.setLevel(logging.INFO)
+    console_level = logging.INFO if process_index == 0 else logging.WARNING
 
-    have_stream = any(
-        type(h) is logging.StreamHandler for h in logger.handlers
+    stream = next(
+        (h for h in logger.handlers if type(h) is logging.StreamHandler),
+        None,
     )
-    if not have_stream:
-        sh = logging.StreamHandler()
-        sh.setFormatter(logging.Formatter("%(message)s"))
-        logger.addHandler(sh)
+    if stream is None:
+        stream = logging.StreamHandler()
+        stream.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(stream)
+    stream.setLevel(console_level)
 
     if log_path:
         log_path = os.path.abspath(log_path)

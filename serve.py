@@ -45,12 +45,26 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from pytorch_cifar_tpu.obs import (
+        MetricsExporter,
+        MetricsRegistry,
+        trace,
+    )
+    from pytorch_cifar_tpu.obs.export import write_prometheus
     from pytorch_cifar_tpu.serve import (
         CheckpointWatcher,
         InferenceEngine,
         MicroBatcher,
     )
     from pytorch_cifar_tpu.serve.loadgen import run_load
+    from pytorch_cifar_tpu.utils import set_logger
+
+    set_logger(None)  # single-process serving: rank-0 console verbosity
+    # ONE registry through engine + batcher + watcher: the exporter and
+    # the Prometheus dump see the whole serving process (OBSERVABILITY.md)
+    registry = MetricsRegistry()
+    if cfg.trace_out:
+        trace.install(cfg.trace_out)
 
     platform = jax.devices()[0].platform
     compute_dtype = (
@@ -70,6 +84,7 @@ def main() -> int:
         compute_dtype=compute_dtype,
         mean=cfg.mean,
         std=cfg.std,
+        registry=registry,
     )
     print(
         f"==> warm: {engine.compile_count} bucket programs compiled, "
@@ -104,11 +119,17 @@ def main() -> int:
         # fail-fast bound on queue time: an engine stall turns into
         # DeadlineExceeded for queued callers instead of unbounded waits
         default_deadline_ms=cfg.deadline_ms,
+        registry=registry,
     )
+    exporter = None
+    if cfg.metrics_out:
+        exporter = MetricsExporter(
+            registry, cfg.metrics_out, interval_s=cfg.metrics_every_s
+        ).start()
     watcher = None
     if cfg.watch:
         watcher = CheckpointWatcher(
-            engine, cfg.ckpt, poll_s=cfg.poll_s
+            engine, cfg.ckpt, poll_s=cfg.poll_s, registry=registry
         ).start()
         print(
             f"==> watching {cfg.ckpt} for new best checkpoints "
@@ -129,7 +150,17 @@ def main() -> int:
         if watcher is not None:
             watcher.stop()
         batcher.close()  # graceful drain
+        if exporter is not None:
+            exporter.stop()
+        if cfg.prom_out:
+            # scrape-file convention (node-exporter textfile collector):
+            # one atomic dump of the final state; a long-lived frontend
+            # would rewrite this per scrape interval
+            write_prometheus(cfg.prom_out, registry.snapshot())
+        if cfg.trace_out:
+            trace.flush()
 
+    obs_summary = registry.summary()
     compiles_after = engine.compile_count
     out = {
         "model": cfg.model,
@@ -150,6 +181,22 @@ def main() -> int:
         **{
             k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in report.items()
+        },
+        # registry-derived health block: queue/occupancy/latency from the
+        # same counters the exporter and Prometheus dump publish
+        "obs": {
+            "queue_depth_max": obs_summary.get("serve.queue_depth.max", 0.0),
+            "batch_occupancy_mean": round(
+                obs_summary.get("serve.batch_occupancy.mean", 0.0), 4
+            ),
+            "latency_p95_ms": round(
+                obs_summary.get("serve.latency_ms.p95", 0.0), 3
+            ),
+            "device_p95_ms": round(
+                obs_summary.get("serve.device_ms.p95", 0.0), 3
+            ),
+            "expired": obs_summary.get("serve.expired", 0.0),
+            "reloads": obs_summary.get("serve.reload.reloads", 0.0),
         },
     }
     print(json.dumps(out))

@@ -69,6 +69,42 @@ def main() -> int:
     assert jax.process_count() == nproc, (jax.process_count(), nproc)
     assert jax.device_count() == 8, jax.device_count()
 
+    if mode == "obs":
+        # cross-host metrics merge (obs/, OBSERVABILITY.md): each rank
+        # holds DIFFERENT process-local values; the allgather-merge must
+        # produce the same global totals on every rank (counters add,
+        # gauges keep the max, histogram buckets add exactly).
+        from pytorch_cifar_tpu.obs.metrics import (
+            MetricsRegistry,
+            allgather_merged,
+            summarize,
+        )
+
+        reg = MetricsRegistry()
+        reg.counter("train.sentinel.bad_steps").inc(pid + 1)
+        reg.gauge("serve.queue_depth").set(10 * (pid + 1))
+        h = reg.histogram("train.step_time_ms", bounds=(1.0, 10.0, 100.0))
+        for v in ([0.5, 5.0] if pid == 0 else [50.0, 500.0, 5.0]):
+            h.observe(v)
+        merged = allgather_merged(reg.snapshot())
+        s = summarize(merged)
+        print(
+            json.dumps(
+                {
+                    "pid": pid,
+                    "bad_steps": s["train.sentinel.bad_steps"],
+                    "queue_max": s["serve.queue_depth.max"],
+                    "hist_count": s["train.step_time_ms.count"],
+                    "hist_counts": merged["histograms"][
+                        "train.step_time_ms"
+                    ]["counts"],
+                    "hist_max": s["train.step_time_ms.max"],
+                }
+            ),
+            flush=True,
+        )
+        return 0
+
     mesh = make_mesh()  # all 8 global devices, both topologies
     sharding = batch_sharding(mesh)
 

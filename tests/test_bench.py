@@ -60,20 +60,32 @@ def test_bench_default_headline_prints_one_json_line():
     assert rec["captures"] == [rec["value"]]
     assert "step_value" not in rec  # cross-walk is a TPU-only extra
     assert "capture 1:" in out.stderr
+    # the obs block (observability PR) rides the same record, parsed from
+    # the child capture via parse_child_record
+    assert {"step_time_p50_ms", "step_time_p95_ms", "input_wait_frac"} <= (
+        set(rec["obs"])
+    )
+    assert rec["obs"]["step_time_p50_ms"] > 0
+    assert rec["obs"]["step_time_p95_ms"] >= rec["obs"]["step_time_p50_ms"]
+    # device-resident data plane: input wait is structurally ~zero
+    assert 0.0 <= rec["obs"]["input_wait_frac"] < 0.5
 
 
 def test_bench_step_mode_prints_one_json_line():
-    """--step preserves the rounds-1-4 per-step program and its exact
-    4-key JSON contract (its metric name carries the historical series)."""
+    """--step preserves the rounds-1-4 per-step program and its JSON
+    contract (its metric name carries the historical series), now plus
+    the obs block every train-side mode carries."""
     rec, _ = run_bench(
         ["--model", "LeNet", "--steps", "2", "--warmup", "1",
          "--batch", "64", "--step"]
     )
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "obs"}
     assert rec["unit"] == "images/sec/chip"
     assert rec["value"] > 0
     assert rec["metric"].startswith("train_throughput_LeNet"), rec["metric"]
     assert rec["metric"].endswith("_cpu"), rec["metric"]
+    assert rec["obs"]["step_time_p50_ms"] > 0
+    assert rec["obs"]["input_wait_frac"] == 0.0  # pre-staged batches
 
 
 def test_prior_round_value_picks_oldest_matching_round(tmp_path, monkeypatch):
@@ -145,6 +157,7 @@ def test_bench_epoch_mode_prints_one_json_line():
     assert rec["metric"].startswith("epoch_throughput_LeNet_b128")
     assert rec["metric"].endswith("_cpu")
     assert rec["value"] > 0
+    assert rec["obs"]["step_time_p50_ms"] > 0  # measured-window samples
 
 
 def test_bench_serve_mode_prints_one_json_line():
@@ -161,6 +174,14 @@ def test_bench_serve_mode_prints_one_json_line():
     assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
     assert rec["p95_ms"] >= rec["p50_ms"]
     assert rec["rejected"] >= 0 and rec["requests"] > 0
+    # serving-side obs block: queue pressure + expiry health from the
+    # batcher's registry (OBSERVABILITY.md)
+    assert {"queue_depth_max", "deadline_expired", "latency_p95_ms"} <= (
+        set(rec["obs"])
+    )
+    assert rec["obs"]["queue_depth_max"] >= 1
+    assert rec["obs"]["deadline_expired"] == 0.0  # no deadlines armed
+    assert rec["obs"]["latency_p95_ms"] > 0
 
 
 def test_parse_child_record_skips_non_record_json_lines():

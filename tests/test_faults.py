@@ -220,6 +220,24 @@ def test_nan_step_skipped_params_finite_and_close_to_clean(tmp_path):
     assert int(faulted.state.step) == int(clean.state.step)
 
 
+def test_sentinel_reports_per_step_indices_under_epoch_scan(tmp_path):
+    """Sentinel telemetry (the deferred ROADMAP item, closed by the
+    observability PR): under the epoch-compiled path the scan carries a
+    per-step non-finite mask, so the trainer reports WHICH global steps
+    were skipped — not just the epoch total. nan_loss at global step 5
+    (epoch 1, step 1 of 4) must be attributed exactly."""
+    faults.inject("nan_loss", 5)
+    tr = Trainer(small_config(tmp_path, epochs=2))
+    assert tr.train_epoch_fn is not None  # epoch-compiled (device_data)
+    tr.train_epoch(0)
+    assert tr.fault_stats["bad_step_indices"] == []  # epoch 0 was clean
+    tr.train_epoch(1)
+    assert tr.fault_stats["bad_steps"] == 1
+    assert tr.fault_stats["bad_step_indices"] == [5]
+    # single source of truth: the view reads the obs registry
+    assert tr.obs.counter("train.sentinel.bad_steps").value == 1.0
+
+
 def test_nan_without_sentinel_poisons_params(tmp_path):
     """Control for the test above: with the sentinel off, the same fault
     propagates NaN into the params — the reference failure mode the

@@ -152,6 +152,24 @@ def test_multiprocess_corrupt_fallback_restore(tmp_path):
         assert r["psum"] == pytest.approx(two[0]["psum"], rel=1e-12)
 
 
+def test_two_process_metrics_merge_agreement(tmp_path):
+    """obs cross-host merge (OBSERVABILITY.md): two processes hold
+    different process-local metrics; allgather_merged must produce the
+    SAME global totals on both ranks — counters add, gauges keep the
+    global max, histogram buckets add exactly."""
+    two = _run_workers(2, 4, str(tmp_path / "obs"), extra_args=("obs",))
+    for r in two:
+        assert r["bad_steps"] == 3.0  # 1 (rank0) + 2 (rank1)
+        assert r["queue_max"] == 20.0  # max(10, 20)
+        assert r["hist_count"] == 5.0  # 2 + 3 observations
+        # buckets (<=1, <=10, <=100, +inf): rank0 {0.5, 5} + rank1
+        # {50, 500, 5} -> [1, 2, 1, 1]
+        assert r["hist_counts"] == [1.0, 2.0, 1.0, 1.0]
+        assert r["hist_max"] == 500.0
+    # byte-level agreement across ranks (deterministic summarize)
+    assert two[0] == {**two[1], "pid": two[0]["pid"]}
+
+
 @pytest.mark.parametrize("spatial", [2, 4])
 def test_two_process_spatial_matches_single_process(tmp_path, spatial):
     """Multi-host spatial partitioning (VERDICT round-1 weak 5): a full

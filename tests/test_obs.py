@@ -1,0 +1,357 @@
+"""Tier-1 contracts for the observability layer (obs/, OBSERVABILITY.md).
+
+Pinned here:
+- registry correctness: counters/gauges/histograms under concurrent
+  mutation, snapshot as a plain JSON-serializable pytree;
+- histogram bucket merge: cross-registry merge adds counts exactly and
+  summaries stay deterministic;
+- trace output is valid Chrome trace-event JSON with correct nesting,
+  parsed by tools/trace_summary.py (the acceptance drill's tool);
+- disabled mode: no tracer installed and no export flags means no extra
+  threads, no log handlers, and a shared no-op span object;
+- a --trace_out Trainer run emits nested train-step + checkpoint spans;
+- the back-compat views (trainer.fault_stats, batcher.stats) read the
+  registry (single source of truth).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+from pytorch_cifar_tpu.obs import (
+    MetricsExporter,
+    MetricsRegistry,
+    merge_snapshots,
+    prometheus_text,
+    summarize,
+    trace,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Every test starts and ends with no installed tracer — span sites
+    are process-global (like the logging root), so a leak would couple
+    test cases."""
+    trace.uninstall(flush=False)
+    yield
+    trace.uninstall(flush=False)
+
+
+# -- registry ------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    r.counter("c").inc()
+    r.counter("c").inc(2.5)
+    assert r.counter("c").value == pytest.approx(3.5)
+
+    g = r.gauge("g")
+    g.set(7)
+    g.set(3)
+    assert g.value == 3 and g.max == 7
+
+    h = r.histogram("h", bounds=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["counts"] == [1.0, 1.0, 1.0, 1.0]  # one per bucket + overflow
+    assert snap["count"] == 4 and snap["sum"] == pytest.approx(555.5)
+    assert snap["min"] == 0.5 and snap["max"] == 500.0
+
+
+def test_registry_same_name_same_instrument_and_kind_conflict():
+    r = MetricsRegistry()
+    assert r.counter("x") is r.counter("x")
+    with pytest.raises(ValueError, match="different kind"):
+        r.gauge("x")
+
+
+def test_snapshot_is_plain_json_pytree():
+    r = MetricsRegistry()
+    r.counter("a").inc()
+    r.gauge("b").set(2)
+    r.histogram("c").observe(1.0)
+    snap = r.snapshot()
+    # JSON round-trip with no custom encoder: the exporter's contract
+    assert json.loads(json.dumps(snap)) == snap
+    # and every leaf is a float or list (mergeable via the collective
+    # helpers after np.asarray — allgather_merged relies on this)
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(snap):
+        assert isinstance(leaf, float), leaf
+
+
+def test_registry_thread_safety():
+    """8 threads x 1000 incs/observes lose nothing (the serving path
+    mutates from submit callers + worker + watcher concurrently)."""
+    r = MetricsRegistry()
+    c = r.counter("n")
+    h = r.histogram("h", bounds=(10.0,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.snapshot()["count"] == 8000
+
+
+def test_histogram_bucket_merge_and_deterministic_summary():
+    """The satellite contract: merging two registries' histograms adds
+    bucket counts exactly; summaries of equal states are byte-identical."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for v in (1.0, 20.0, 20.0):
+        a.histogram("lat", bounds=(5.0, 50.0)).observe(v)
+    for v in (2.0, 300.0):
+        b.histogram("lat", bounds=(5.0, 50.0)).observe(v)
+    a.counter("n").inc(3)
+    b.counter("n").inc(2)
+    b.gauge("q").set(9)
+
+    merged = merge_snapshots(a.snapshot(), b.snapshot())
+    h = merged["histograms"]["lat"]
+    assert h["counts"] == [2.0, 2.0, 1.0]
+    assert h["count"] == 5 and h["sum"] == pytest.approx(343.0)
+    assert h["min"] == 1.0 and h["max"] == 300.0
+    assert merged["counters"]["n"] == 5.0
+    assert merged["gauges"]["q"]["max"] == 9.0
+    # determinism: same inputs -> identical serialized summary
+    s1 = json.dumps(summarize(merged))
+    s2 = json.dumps(summarize(merge_snapshots(a.snapshot(), b.snapshot())))
+    assert s1 == s2
+    # p95 of 5 samples lands in the top bucket, clamped by observed max
+    assert summarize(merged)["lat.p95"] <= 300.0
+
+    # mismatched bounds must fail loudly, never mis-merge
+    c = MetricsRegistry()
+    c.histogram("lat", bounds=(1.0, 2.0)).observe(1.0)
+    with pytest.raises(ValueError, match="bounds differ"):
+        merge_snapshots(a.snapshot(), c.snapshot())
+
+
+def test_prometheus_text_format():
+    r = MetricsRegistry()
+    r.counter("serve.requests").inc(4)
+    r.gauge("serve.queue_depth").set(3)
+    r.histogram("serve.latency_ms", bounds=(1.0, 10.0)).observe(5.0)
+    text = prometheus_text(r.snapshot())
+    assert "pct_serve_requests 4" in text
+    assert "pct_serve_queue_depth 3" in text
+    assert 'pct_serve_latency_ms_bucket{le="10"} 1' in text
+    assert 'pct_serve_latency_ms_bucket{le="+Inf"} 1' in text
+    assert "pct_serve_latency_ms_count 1" in text
+
+
+# -- trace ---------------------------------------------------------------
+
+
+def test_trace_emits_valid_chrome_trace_json(tmp_path):
+    path = str(tmp_path / "t.json")
+    trace.install(path)
+    with trace.span("outer", epoch=1):
+        with trace.span("inner"):
+            pass
+    trace.instant("marker", kind="x")
+    trace.uninstall()  # flushes
+
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and len(events) == 3
+    for e in events:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+    by_name = {e["name"]: e for e in events}
+    assert by_name["outer"]["ph"] == "X" and by_name["inner"]["ph"] == "X"
+    assert by_name["marker"]["ph"] == "i"
+    # nesting: inner lies within outer's [ts, ts+dur) window
+    o, i = by_name["outer"], by_name["inner"]
+    assert o["ts"] <= i["ts"]
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+    assert o["args"] == {"epoch": 1}
+
+
+def test_trace_summary_tool_parses_and_computes_self_time(tmp_path):
+    """tools/trace_summary.py on a tracer-written file: totals include
+    children, self time excludes them."""
+    import time
+
+    from tools.trace_summary import load_events, main, summarize_spans
+
+    path = str(tmp_path / "t.json")
+    trace.install(path)
+    with trace.span("parent"):
+        with trace.span("child"):
+            time.sleep(0.02)
+    trace.uninstall()
+
+    spans = summarize_spans(load_events(path))
+    assert spans["parent"]["count"] == 1 and spans["child"]["count"] == 1
+    assert spans["child"]["total_us"] >= 20_000 * 0.5
+    assert spans["parent"]["total_us"] >= spans["child"]["total_us"]
+    # parent's self time excludes the child's whole duration
+    assert spans["parent"]["self_us"] == pytest.approx(
+        spans["parent"]["total_us"] - spans["child"]["total_us"]
+    )
+    # CLI contract: exit 0 + parseable --json output
+    assert main([path, "--json"]) == 0
+    assert main([path, "--n", "5", "--sort", "self"]) == 0
+    # malformed input: exit 1, not a traceback
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert main([str(bad)]) == 1
+
+
+def test_disabled_mode_no_threads_no_handlers_no_tracer(tmp_path):
+    """OFF by default: instrumented code paths add no threads, install no
+    tracer, and the span gate returns one shared no-op object."""
+    s1, s2 = trace.span("a"), trace.span("b", k=1)
+    assert s1 is s2  # the shared no-op, allocation-free
+    with s1:
+        pass
+    trace.instant("nothing")  # swallowed
+
+    threads_before = set(threading.enumerate())
+    handlers_before = list(logging.getLogger().handlers)
+    r = MetricsRegistry()
+    r.counter("x").inc()
+    r.histogram("y").observe(1.0)
+    # an exporter that was never started spawns nothing
+    MetricsExporter(r, str(tmp_path / "m.jsonl"), interval_s=0.01)
+    assert set(threading.enumerate()) == threads_before
+    assert list(logging.getLogger().handlers) == handlers_before
+    assert trace.installed() is None
+    assert not (tmp_path / "m.jsonl").exists()
+
+
+def test_exporter_writes_jsonl_and_final_line(tmp_path):
+    r = MetricsRegistry()
+    r.counter("c").inc(2)
+    path = tmp_path / "metrics.jsonl"
+    ex = MetricsExporter(r, str(path), interval_s=3600.0).start()
+    assert any(
+        t.name == "metrics-exporter" for t in threading.enumerate()
+    )
+    ex.stop()  # interval never elapsed -> the final line is the only one
+    assert not any(
+        t.name == "metrics-exporter" for t in threading.enumerate()
+    )
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["metrics"]["counters"]["c"] == 2.0
+    assert {"ts_s", "seq"} <= set(lines[0])
+
+
+# -- end-to-end: instrumented Trainer ------------------------------------
+
+
+@pytest.fixture
+def small_cfg(tmp_path):
+    from pytorch_cifar_tpu.config import TrainConfig
+
+    def make(**kw):
+        defaults = dict(
+            model="LeNet",
+            epochs=2,
+            batch_size=64,
+            eval_batch_size=64,
+            synthetic_data=True,
+            synthetic_train_size=256,
+            synthetic_test_size=128,
+            lr=0.02,
+            output_dir=str(tmp_path / "out"),
+            amp=False,
+            log_every=1000,
+        )
+        defaults.update(kw)
+        return TrainConfig(**defaults)
+
+    return make
+
+
+def test_trainer_trace_out_nested_train_and_checkpoint_spans(
+    small_cfg, tmp_path
+):
+    """The acceptance drill in-process: a 2-epoch run with --trace_out
+    produces a file tools/trace_summary.py parses, containing train-step
+    spans nested in epoch spans and nested checkpoint spans."""
+    from pytorch_cifar_tpu.train.trainer import Trainer
+    from tools.trace_summary import load_events, summarize_spans
+
+    tpath = str(tmp_path / "trace.json")
+    cfg = small_cfg(
+        trace_out=tpath,
+        # host data plane: the per-step loop is what emits train/step
+        # spans (the one-dispatch path has no host-visible steps)
+        device_data=False,
+        host_augment=True,
+        async_checkpoint=False,
+    )
+    Trainer(cfg).fit()
+    trace.uninstall(flush=False)  # fit() already flushed
+
+    spans = summarize_spans(load_events(tpath))
+    assert spans["train/epoch"]["count"] == 2
+    assert spans["train/step"]["count"] == 2 * 4  # 256/64 steps per epoch
+    assert spans["eval/epoch"]["count"] == 2
+    assert spans["checkpoint/save"]["count"] >= 1
+    # nesting is real: steps are inside epochs, device_get+write inside
+    # save — so the parents' SELF time excludes the children
+    assert spans["train/epoch"]["self_us"] < spans["train/epoch"]["total_us"]
+    assert spans["checkpoint/save"]["self_us"] < (
+        spans["checkpoint/save"]["total_us"]
+    )
+    assert spans["checkpoint/write"]["count"] >= 1
+
+
+def test_trainer_registry_and_fault_stats_view(small_cfg):
+    """trainer.obs carries the timing/io metrics; fault_stats is a view
+    over the same registry (single source of truth)."""
+    from pytorch_cifar_tpu.train.trainer import Trainer
+
+    cfg = small_cfg(epochs=1, async_checkpoint=False)
+    tr = Trainer(cfg)
+    tr.fit()
+    s = tr.obs.summary()
+    assert s["train.epochs"] == 1.0
+    assert s["train.step_time_ms.count"] == 1.0
+    assert s["checkpoint.saves"] >= 1.0
+    assert s["checkpoint.saved_bytes"] > 0
+    # the view reads the registry counters
+    assert tr.fault_stats["bad_steps"] == int(
+        tr.obs.counter("train.sentinel.bad_steps").value
+    )
+
+
+def test_batcher_stats_view_reads_registry():
+    """The PR 1 stats dict is now a read view over serve.* counters."""
+    from pytorch_cifar_tpu.serve import InferenceEngine, MicroBatcher
+
+    eng = InferenceEngine.from_random("LeNet", buckets=(4,))
+    b = MicroBatcher(eng, max_batch=4, max_wait_ms=0.0, max_queue=8)
+    try:
+        x = np.zeros((3, 32, 32, 3), np.uint8)
+        b.predict(x)
+    finally:
+        b.close()
+    assert b.stats["requests"] == 1
+    assert b.stats["images"] == 3
+    assert b.stats["largest_batch"] == 3
+    assert b.obs.counter("serve.requests").value == 1
+    assert b.obs.gauge("serve.queue_depth").max >= 3
+    snap = b.obs.histogram("serve.latency_ms").snapshot()
+    assert snap["count"] == 1 and snap["max"] > 0
+    occ = b.obs.histogram("serve.batch_occupancy").snapshot()
+    assert occ["count"] == 1 and occ["max"] == pytest.approx(0.75)

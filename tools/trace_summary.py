@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Fold a --trace_out Chrome trace-event file into a top-spans table.
+
+The chaos and bench drills eyeball regressions with this instead of
+loading every trace into ui.perfetto.dev: it reads the JSON a Tracer
+(pytorch_cifar_tpu/obs/trace.py) — or any Chrome trace-event producer —
+wrote, reconstructs span nesting per (pid, tid) from (ts, dur), and
+prints each span name's call count, TOTAL time (sum of durations) and
+SELF time (total minus time spent in nested child spans — the number
+that says where the time actually goes, since a parent span contains
+its children's totals).
+
+    python tools/trace_summary.py checkpoint/trace.json
+    python tools/trace_summary.py trace.json --n 10 --sort self --json
+
+Stdlib-only: usable on any host that has the trace file, no jax needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse a trace file: the ``{"traceEvents": [...]}`` object form or
+    the bare JSON-array form (both are valid Chrome trace formats)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError(
+                f"{path}: JSON object without a 'traceEvents' list"
+            )
+    elif isinstance(doc, list):
+        events = doc
+    else:
+        raise ValueError(f"{path}: neither a trace object nor an array")
+    for e in events:
+        if not isinstance(e, dict) or "ph" not in e or "name" not in e:
+            raise ValueError(f"{path}: malformed trace event: {e!r}")
+    return events
+
+
+def summarize_spans(events: List[dict]) -> Dict[str, dict]:
+    """Per-name {count, total_us, self_us} over complete ("X") events.
+
+    Self time subtracts nested children: within one (pid, tid) lane,
+    spans are sorted by (ts, -dur) and a stack assigns each span's
+    duration to its innermost enclosing parent — the same reconstruction
+    trace viewers do."""
+    lanes: Dict[tuple, List[dict]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        lanes.setdefault((e.get("pid", 0), e.get("tid", 0)), []).append(e)
+
+    out: Dict[str, dict] = {}
+
+    def bucket(name):
+        return out.setdefault(
+            name, {"count": 0, "total_us": 0.0, "self_us": 0.0}
+        )
+
+    for lane in lanes.values():
+        # equal ts: the longer span is the parent — sort it first
+        lane.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[dict] = []  # open spans, innermost last
+        for e in lane:
+            ts, dur = float(e["ts"]), float(e.get("dur", 0.0))
+            while stack and ts >= stack[-1]["_end"]:
+                stack.pop()
+            if stack:
+                # child time is charged to the span, not the parent's self
+                stack[-1]["_child_us"] += dur
+            e["_end"] = ts + dur
+            e["_child_us"] = 0.0
+            stack.append(e)
+        for e in lane:
+            b = bucket(e["name"])
+            b["count"] += 1
+            b["total_us"] += float(e.get("dur", 0.0))
+            b["self_us"] += float(e.get("dur", 0.0)) - e["_child_us"]
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("trace", help="trace-event JSON file (--trace_out)")
+    parser.add_argument(
+        "--n", type=int, default=20, help="top-N span names to print"
+    )
+    parser.add_argument(
+        "--sort", choices=["total", "self"], default="total",
+        help="rank by total time (default) or self time",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output (one JSON object)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    spans = summarize_spans(events)
+    n_instants = sum(1 for e in events if e.get("ph") == "i")
+
+    key = "total_us" if args.sort == "total" else "self_us"
+    ranked = sorted(spans.items(), key=lambda kv: -kv[1][key])[: args.n]
+
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "spans": {
+                        name: {
+                            "count": s["count"],
+                            "total_ms": round(s["total_us"] / 1e3, 3),
+                            "self_ms": round(s["self_us"] / 1e3, 3),
+                        }
+                        for name, s in ranked
+                    },
+                    "span_events": sum(s["count"] for s in spans.values()),
+                    "instant_events": n_instants,
+                }
+            )
+        )
+        return 0
+
+    if not ranked:
+        print("no complete ('X') span events in trace")
+        return 0
+    w = max(len(name) for name, _ in ranked)
+    print(
+        f"{'span':<{w}}  {'count':>7}  {'total ms':>12}  {'self ms':>12}"
+    )
+    for name, s in ranked:
+        print(
+            f"{name:<{w}}  {s['count']:>7}  "
+            f"{s['total_us'] / 1e3:>12.3f}  {s['self_us'] / 1e3:>12.3f}"
+        )
+    if n_instants:
+        print(f"({n_instants} instant event(s) not shown)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,17 +18,27 @@ def main(argv=None) -> float:
     from pytorch_cifar_tpu.train.trainer import Trainer
 
     config = parse_config(argv)
-    trainer = Trainer(config)  # installs the logger (primary process only)
+    trainer = Trainer(config)  # installs the rank-aware logger
     best = trainer.fit()
     stats = trainer.fault_stats
     if stats["bad_steps"] or stats["rollbacks"]:
         # surfaced on the CLI, not only in the log: a run that survived
-        # divergence should say so where the operator is looking
+        # divergence should say so where the operator is looking —
+        # including WHICH global steps were skipped (per-step attribution
+        # from the epoch-compiled scan; OBSERVABILITY.md)
+        where = (
+            f" at step(s) {stats['bad_step_indices']}"
+            if stats["bad_step_indices"]
+            else ""
+        )
         print(
             f"divergence sentinel: {stats['bad_steps']} non-finite "
-            f"step(s) handled, {stats['rollbacks']} rollback(s) "
+            f"step(s) handled{where}, {stats['rollbacks']} rollback(s) "
             f"(policy {config.sentinel})"
         )
+    if config.trace_out:
+        print(f"trace written to {config.trace_out} "
+              f"(open in ui.perfetto.dev or tools/trace_summary.py)")
     print(f"best test accuracy: {best:.2f}%")
     return best
 
