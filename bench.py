@@ -389,19 +389,41 @@ def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
 def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
     """Serving-side north-star: closed-loop request latency + img/s
     through the full serve stack (bucket-compiled engine + micro-batcher;
-    serve/ and SERVING.md). Random-init weights — serving throughput
-    depends on the compiled program, not the parameter values. Returns
-    the loadgen report plus the config keys the metric name needs."""
+    serve/ and SERVING.md), sharded over EVERY local device (the serving
+    counterpart of the MULTICHIP train series: the record carries
+    ``n_devices`` + ``img_per_sec_per_chip`` so serve numbers land next
+    to the per-chip train metric). Random-init weights — serving
+    throughput depends on the compiled program, not the parameter values.
+    Returns the loadgen report plus the config keys the metric name
+    needs."""
+    from pytorch_cifar_tpu.parallel import make_mesh
     from pytorch_cifar_tpu.serve import InferenceEngine, MicroBatcher
     from pytorch_cifar_tpu.serve.loadgen import run_load
 
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+
+    mesh = make_mesh()
+    n_devices = int(mesh.devices.size)
+    if n_devices == 1:
+        mesh = None  # exact single-chip engine path
     max_b = min(128, batch)
     buckets = tuple(sorted({b for b in (1, 8, 32, max_b) if b <= max_b}))
+    # one registry through engine + batcher so the obs block sees both
+    # the sharded-put timing and the queue counters
+    registry = MetricsRegistry()
     engine = InferenceEngine.from_random(
-        model, buckets=buckets, compute_dtype=compute_dtype
+        model,
+        buckets=buckets,
+        compute_dtype=compute_dtype,
+        mesh=mesh,
+        registry=registry,
     )
     batcher = MicroBatcher(
-        engine, max_batch=max_b, max_wait_ms=2.0, max_queue=8 * max_b
+        engine,
+        max_batch=max_b,
+        max_wait_ms=2.0,
+        max_queue=8 * max_b,
+        registry=registry,
     )
     try:
         run_load(  # warmup pass: page in the executables under threads
@@ -420,6 +442,10 @@ def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
         "serving bench recompiled after warmup"
     )
     report["max_batch"] = max_b
+    report["n_devices"] = n_devices
+    report["img_per_sec_per_chip"] = round(
+        report["img_per_sec"] / max(n_devices, 1), 3
+    )
     # serving-side obs block from the batcher's registry (queue pressure
     # and expiry health ride the same single-line record as throughput)
     s = batcher.obs.summary()
@@ -430,6 +456,12 @@ def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
             s.get("serve.batch_occupancy.mean", 0.0), 4
         ),
         "latency_p95_ms": round(s.get("serve.latency_ms.p95", 0.0), 3),
+        # mesh engines only (0.0 single-chip): sharded-batch assembly
+        # time and per-shard row occupancy
+        "put_p95_ms": round(s.get("serve.put_ms.p95", 0.0), 3),
+        "shard_images_mean": round(
+            s.get("serve.shard_images.mean", 0.0), 3
+        ),
     }
     return report
 
@@ -727,6 +759,7 @@ def main() -> int:
     compute_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     extra = {}
+    unit = "images/sec/chip"
     if args.pipeline:
         value = run_pipeline(args.batch, max(args.steps, 20))
         # no dtype component: the pipeline moves uint8 regardless of --dtype,
@@ -735,6 +768,9 @@ def main() -> int:
     elif args.serve:
         report = run_serve(args.model, args.batch, args.steps, compute_dtype)
         value = report["img_per_sec"]
+        # `value` is TOTAL throughput over the whole serving mesh — the
+        # per-chip number rides along as img_per_sec_per_chip
+        unit = "images/sec"
         # latency SLO percentiles ride along in the same single-line record
         extra = {
             k: round(report[k], 3)
@@ -743,7 +779,12 @@ def main() -> int:
         extra.update(
             requests=report["requests"],
             rejected=report["rejected"],
+            hedged=report["hedged"],
             clients=report["clients"],
+            # MULTICHIP serve contract: devices + per-chip throughput
+            # next to the total img/s `value`
+            n_devices=report["n_devices"],
+            img_per_sec_per_chip=report["img_per_sec_per_chip"],
             obs=report["obs"],
         )
         name = f"serve_throughput_{args.model}_b{report['max_batch']}"
@@ -785,7 +826,7 @@ def main() -> int:
 
     if not args.pipeline:
         metric = f"{name}_{args.dtype}_{platform}"
-    rec = core_record(metric, value)
+    rec = core_record(metric, value, unit=unit)
     rec.update(extra)
     print(json.dumps(rec))
     return 0

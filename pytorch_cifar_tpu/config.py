@@ -187,6 +187,12 @@ class ServeConfig:
     # engine: one AOT-compiled forward per bucket; partial batches pad up
     # to the nearest bucket, so after warmup NO request shape compiles
     buckets: Tuple[int, ...] = (1, 8, 32, 128)
+    # device mesh (mirrors train's --num_devices; 0 = ALL local devices):
+    # each bucket program's batch axis is sharded over a 1-D data mesh and
+    # the weights are placed replicated, so serve throughput scales with
+    # chips. Bucket sizes round up to mesh multiples (SERVING.md). 1 =
+    # the single-chip engine exactly as before.
+    num_devices: int = 0
     dtype: str = "bfloat16"  # serving compute dtype; logits return fp32
     mean: Tuple[float, float, float] = (0.4914, 0.4822, 0.4465)
     std: Tuple[float, float, float] = (0.2023, 0.1994, 0.2010)
@@ -214,6 +220,11 @@ class ServeConfig:
     request_images_max: int = 8  # request size ~ U[1, this]
     duration_s: float = 0.0  # optional wall-clock cap (0 = none)
     seed: int = 0
+    # retry-once hedge: a DeadlineExceeded request is resubmitted once
+    # (fresh deadline, counted in `hedged` + the serve.hedged counter)
+    # before being surfaced as failed — the frontend half of the
+    # ROBUSTNESS.md retry/hedging item. --no-hedge fails fast instead.
+    hedge: bool = True
 
     # verify bit-identity of the padded bucket path against a direct
     # unpadded jitted forward before serving (one extra compile)

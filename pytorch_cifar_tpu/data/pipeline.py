@@ -442,6 +442,29 @@ class DeviceDataset:
             )
 
 
+def put_sharded_array(
+    x: np.ndarray, sharding: jax.sharding.Sharding
+) -> jax.Array:
+    """Place ONE host-materialized global array onto the mesh.
+
+    Single-process: a plain sharded device_put. Multi-process: every
+    process holds the same global array; each contributes only its
+    contiguous slab and the global array is assembled from process-local
+    shards. Shared by the eval/serve paths (``put_global`` and the
+    serving engine's sharded batch put) so the multi-process assembly
+    arithmetic lives in exactly one place.
+    """
+    if jax.process_count() > 1:
+        (r0, r1), (h0, h1) = local_slab(sharding, x.shape)
+        xl = x[r0:r1]
+        if x.ndim > 1 and (h0, h1) != (0, x.shape[1]):
+            xl = np.ascontiguousarray(xl[:, h0:h1])
+        return jax.make_array_from_process_local_data(
+            sharding, xl, x.shape
+        )
+    return jax.device_put(x, sharding)
+
+
 def put_global(
     x: np.ndarray,
     y: np.ndarray,
@@ -457,23 +480,13 @@ def put_global(
     """
     if label_sharding is None:
         label_sharding = sharding
-    if jax.process_count() > 1:
-        if sharding is None:
-            raise ValueError("multi-process put_global requires a sharding")
-        (r0, r1), (h0, h1) = local_slab(sharding, x.shape)
-        xl = x[r0:r1]
-        if (h0, h1) != (0, x.shape[1]):
-            xl = np.ascontiguousarray(xl[:, h0:h1])
-        (y0, y1), _ = local_slab(label_sharding, y.shape)
-        yl = y[y0:y1]
-        return (
-            jax.make_array_from_process_local_data(sharding, xl, x.shape),
-            jax.make_array_from_process_local_data(
-                label_sharding, yl, y.shape
-            ),
-        )
+    if jax.process_count() > 1 and sharding is None:
+        raise ValueError("multi-process put_global requires a sharding")
     if sharding is not None:
-        return jax.device_put(x, sharding), jax.device_put(y, label_sharding)
+        return (
+            put_sharded_array(x, sharding),
+            put_sharded_array(y, label_sharding),
+        )
     return jax.device_put(x), jax.device_put(y)
 
 

@@ -57,8 +57,31 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
 
 def replicate(tree, mesh: Mesh):
     """Place a pytree fully-replicated on the mesh (DDP's init-time param
-    broadcast, main_dist.py:141-144)."""
+    broadcast, main_dist.py:141-144).
+
+    Multi-process on a fragile-gloo stack (jax 0.4.x CPU — see
+    ``mesh.gloo_transport_fragile``): jax's own multi-process
+    ``device_put`` onto a non-addressable sharding runs a per-leaf
+    ``assert_equal`` — a variable-size ``broadcast_one_to_all`` per leaf
+    through gloo's TCP transport, which flakily aborts the whole process
+    when two transfers of different sizes pair up (the
+    ``op.preamble.length <= op.nbytes`` crash). Every replicate caller
+    already guarantees identical values on all processes (same-seed init,
+    or a checkpoint broadcast from process 0), so the replicated array is
+    assembled from process-local data instead — no collective at all.
+    """
+    import numpy as np
+
+    from pytorch_cifar_tpu.parallel.mesh import gloo_transport_fragile
+
     sharding = NamedSharding(mesh, P())
+    if jax.process_count() > 1 and gloo_transport_fragile():
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            ),
+            tree,
+        )
     return jax.device_put(tree, sharding)
 
 

@@ -98,3 +98,83 @@ def is_primary() -> bool:
     """True on the process that owns logging/checkpoint writes (the SPMD
     equivalent of the reference's rank-0 gating, main_dist.py:78-82,243)."""
     return jax.process_index() == 0
+
+
+# Chunk size for the gloo-safe broadcast below. Uniform 64 KiB transfers:
+# small enough to sit far under any gloo TCP unbound-buffer limit, uniform
+# so every chunked collective reuses ONE compiled program (and no two
+# in-flight transfers can disagree about their length).
+_BROADCAST_CHUNK_BYTES = 1 << 16
+
+
+def gloo_transport_fragile() -> bool:
+    """True when large/irregular host-side broadcasts must be avoided:
+    jax 0.4.x's CPU cross-process collectives run over gloo's TCP
+    transport, which aborts the whole process when two transfers of
+    different sizes pair up on a connection (``op.preamble.length <=
+    op.nbytes`` check failure inside pair.cc — observed in this container
+    on jax 0.4.37 as the ``test_cross_topology_checkpoint_resume`` crash;
+    ROADMAP). Two call sites route around it: :func:`broadcast_pytree`
+    (uniform chunks instead of one big variable-size broadcast) and
+    ``parallel.dp.replicate`` (process-local assembly instead of jax's
+    per-leaf ``assert_equal`` broadcast storm inside multi-process
+    ``device_put``). Version-gated so newer jaxlib (and every non-CPU
+    backend, where collectives never touch gloo) keeps the one-shot fast
+    paths."""
+    if jax.devices()[0].platform != "cpu":
+        return False
+    try:
+        major, minor = (int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:  # unparseable dev version: assume current (fixed)
+        return False
+    return (major, minor) < (0, 5)
+
+
+def broadcast_pytree(tree, chunk_bytes: int = _BROADCAST_CHUNK_BYTES):
+    """Broadcast a host pytree process-0 -> all processes.
+
+    Same contract as ``multihost_utils.broadcast_one_to_all`` (every
+    process passes a structurally identical tree — non-source values are
+    placeholders — and gets numpy leaves back), which this simply wraps
+    on healthy stacks. On jax 0.4.x CPU (gloo transport, see
+    :func:`gloo_transport_fragile`) the leaves are packed into one
+    byte buffer and broadcast in fixed-size chunks instead: many small
+    uniform transfers where the one-shot path crashes the process inside
+    gloo. Single-process: the tree comes back unchanged.
+    """
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    if not gloo_transport_fragile():
+        return multihost_utils.broadcast_one_to_all(tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.ascontiguousarray(leaf) for leaf in leaves]
+    packed = (
+        np.concatenate([a.reshape(-1).view(np.uint8) for a in arrs])
+        if arrs
+        else np.zeros(0, np.uint8)
+    )
+    # pad to a whole number of uniform chunks: every broadcast call then
+    # has the same shape, so one compiled collective serves them all
+    nchunks = max(1, -(-packed.nbytes // chunk_bytes))
+    padded = np.zeros(nchunks * chunk_bytes, np.uint8)
+    padded[: packed.nbytes] = packed
+    got = np.concatenate(
+        [
+            np.asarray(
+                multihost_utils.broadcast_one_to_all(
+                    padded[i * chunk_bytes : (i + 1) * chunk_bytes]
+                ),
+                np.uint8,
+            )
+            for i in range(nchunks)
+        ]
+    )[: packed.nbytes]
+    out, off = [], 0
+    for a in arrs:
+        out.append(
+            got[off : off + a.nbytes].view(a.dtype).reshape(a.shape)
+        )
+        off += a.nbytes
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -82,6 +82,16 @@ class MicroBatcher:
         self.max_batch = int(max_batch or max(engine.buckets))
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        # mesh-sharded engine: a formed batch is laid out over the data
+        # axis, so round max_batch UP to the shard multiple — a full
+        # coalesced batch then fills every shard evenly instead of
+        # guaranteeing pad rows on the trailing shard
+        self.shard_multiple = int(getattr(engine, "n_devices", 1) or 1)
+        if self.shard_multiple > 1:
+            self.max_batch = (
+                -(-self.max_batch // self.shard_multiple)
+                * self.shard_multiple
+            )
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
         if self.max_queue < self.max_batch:
@@ -120,6 +130,18 @@ class MicroBatcher:
         )
         # admission -> result latency, the client-observed number
         self._h_latency = self.obs.histogram("serve.latency_ms")
+        # per-shard valid-row occupancy of each dispatched batch (mesh
+        # engines only): a ragged tail batch leaves trailing shards
+        # padded — this histogram is how uneven the split actually ran
+        self._h_shard = (
+            self.obs.histogram(
+                "serve.shard_images",
+                bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+            )
+            if self.shard_multiple > 1
+            and hasattr(engine, "shard_split")
+            else None
+        )
         if autostart:
             self.start()
 
@@ -260,6 +282,9 @@ class MicroBatcher:
             self._c_images.inc(total)
             self._h_batch.observe(total)
             self._h_occupancy.observe(total / self.max_batch)
+            if self._h_shard is not None:
+                for rows in self.engine.shard_split(total):
+                    self._h_shard.observe(rows)
         return batch
 
     def _worker(self) -> None:

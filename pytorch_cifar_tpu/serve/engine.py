@@ -21,10 +21,22 @@ Design constraints (SERVING.md has the full rationale):
   trees have identical avals (same model, same dtypes — so the compiled
   executables remain valid) and replaces the reference in one assignment.
   Requests already executing keep the tuple they captured.
+- **Multi-chip serving is the same engine over a mesh.** Pass ``mesh=``
+  (``parallel/mesh.py``) and each bucket program is AOT-compiled with its
+  batch axis sharded over the mesh's data axis while the weights are
+  placed replicated — the batch-parallel serving layout (ORCA/Clipper
+  style): throughput scales with chips, one program per bucket, still no
+  recompiles on weight swap (the swap re-puts through the same mesh-aware
+  placement, so the hot-reload watcher needs no extra plumbing). Bucket
+  sizes round UP to multiples of the data-axis size so every shard gets
+  the same static extent; padding semantics are unchanged and per-row
+  outputs stay bit-identical to the single-device engine (eval forward is
+  per-row independent — pinned by tests on the forced-8-device CPU host).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -35,7 +47,21 @@ import numpy as np
 from pytorch_cifar_tpu import faults
 from pytorch_cifar_tpu.obs import trace
 
+log = logging.getLogger(__name__)
+
 DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+def round_buckets(buckets: Sequence[int], multiple: int) -> Tuple[int, ...]:
+    """Round each bucket UP to a multiple of ``multiple`` and dedupe.
+
+    The bucket-rounding rule for mesh serving (SERVING.md): a sharded
+    program needs the same static per-shard extent on every device, so a
+    bucket must be divisible by the data-axis size. Rounding UP (never
+    down) preserves the invariant that any request <= the old largest
+    bucket still fits without chunking."""
+    m = max(1, int(multiple))
+    return tuple(sorted({-(-int(b) // m) * m for b in buckets}))
 
 
 def load_checkpoint_trees(
@@ -153,6 +179,7 @@ class InferenceEngine:
         image_shape: Tuple[int, int, int] = (32, 32, 3),
         warmup: bool = True,
         registry=None,
+        mesh=None,
     ):
         import jax.numpy as jnp
 
@@ -168,6 +195,48 @@ class InferenceEngine:
         self.buckets = tuple(sorted({int(b) for b in buckets}))
         if self.buckets[0] < 1:
             raise ValueError(f"buckets must be >= 1, got {self.buckets}")
+        # data-parallel serving mesh (parallel/mesh.py): batch axis of every
+        # bucket program sharded over the mesh's FIRST axis, weights
+        # replicated. mesh=None keeps the exact single-device path.
+        self.mesh = mesh
+        self._singleton = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.n_devices = int(np.prod(mesh.devices.shape))
+            axis = mesh.axis_names[0]
+            self._repl_sharding = NamedSharding(mesh, P())
+            self._batch_sharding = NamedSharding(mesh, P(axis))
+            if self.n_devices > 1:
+                # The mesh bucket-rounding rule (SERVING.md): buckets
+                # round UP to multiples of the data-axis size D so every
+                # shard gets the same static extent — with a floor of 2*D,
+                # because a per-shard extent of 1 selects XLA:CPU's
+                # batch-1 conv kernels, whose rounding differs bitwise
+                # from ANY batch>=2 program (measured; extents >= 2 are
+                # mutually bit-identical). A configured 1-bucket survives
+                # as a per-shard-1 "singleton" program of size exactly D,
+                # used ONLY for n==1 requests — the same kernel class as
+                # the single-device engine's bucket 1, keeping n==1 bits
+                # identical across topologies.
+                d = self.n_devices
+                rounded = round_buckets(
+                    [max(b, 2 * d) for b in self.buckets if b > 1], d
+                ) or (2 * d,)
+                if 1 in self.buckets:
+                    self._singleton = d
+                    rounded = tuple(sorted({d, *rounded}))
+                if rounded != self.buckets:
+                    log.info(
+                        "rounded buckets %s -> %s (multiples of the "
+                        "%d-device data axis, per-shard extent >= 2)",
+                        self.buckets, rounded, d,
+                    )
+                self.buckets = rounded
+        else:
+            self.n_devices = 1
+            self._repl_sharding = None
+            self._batch_sharding = None
         self.model_name = model_name
         self.num_classes = num_classes
         self.image_shape = tuple(image_shape)
@@ -213,6 +282,14 @@ class InferenceEngine:
             if registry is not None
             else None
         )
+        # sharded-batch assembly time (mesh only): the host->mesh put that
+        # replaces the executable's own single-device transfer. Against
+        # serve.device_ms this splits input placement from device time.
+        self._h_put = (
+            registry.histogram("serve.put_ms")
+            if registry is not None and mesh is not None
+            else None
+        )
         self._set_weights(params, batch_stats)
         if warmup:
             self.warmup()
@@ -222,15 +299,31 @@ class InferenceEngine:
     def _set_weights(self, params, batch_stats) -> None:
         import jax
 
-        # one H2D put at swap time, not per request
-        self._weights = jax.device_put((params, batch_stats or {}))
+        # one H2D put at swap time, not per request. With a mesh the put is
+        # REPLICATED over every device — the hot-reload watcher routes
+        # through here too (swap_weights), so a checkpoint swap lands on
+        # all chips in the same single assignment. parallel.replicate
+        # rather than a raw device_put: it sidesteps jax 0.4.x's fragile
+        # per-leaf gloo assert broadcast under multi-process meshes.
+        if self.mesh is not None:
+            from pytorch_cifar_tpu.parallel import replicate
+
+            self._weights = replicate((params, batch_stats or {}), self.mesh)
+        else:
+            self._weights = jax.device_put((params, batch_stats or {}))
 
     @staticmethod
     def _avals(tree):
         import jax
 
+        # getattr dtype first: np.asarray would have to FETCH a mesh
+        # array (and cannot fetch a multi-process one at all)
         return [
-            (jax.tree_util.keystr(p), np.shape(v), np.asarray(v).dtype)
+            (
+                jax.tree_util.keystr(p),
+                np.shape(v),
+                getattr(v, "dtype", None) or np.asarray(v).dtype,
+            )
             for p, v in jax.tree_util.tree_leaves_with_path(tree)
         ]
 
@@ -273,22 +366,75 @@ class InferenceEngine:
             if b in self._compiled:
                 continue
             x = jnp.zeros((b, *self.image_shape), jnp.uint8)
-            with trace.span("serve/compile_bucket", bucket=b):
+            if self._batch_sharding is not None:
+                # batch axis over the data mesh; weights are already
+                # committed replicated, so jit infers their shardings and
+                # the per-row program contains NO collectives (eval
+                # forward is row-independent — out stays batch-sharded)
+                x = jax.device_put(x, self._batch_sharding)
+            jitted = (
+                jax.jit(self._fwd, out_shardings=self._batch_sharding)
+                if self._batch_sharding is not None
+                else jax.jit(self._fwd)
+            )
+            with trace.span(
+                "serve/compile_bucket", bucket=b, devices=self.n_devices
+            ):
                 self._compiled[b] = (
-                    jax.jit(self._fwd).lower(params, stats, x).compile()
+                    jitted.lower(params, stats, x).compile()
                 )
             self.compile_count += 1
             if self._obs is not None:
                 self._obs.counter("serve.compiles").inc()
 
     def bucket_for(self, n: int) -> int:
-        """Smallest bucket >= n, or the largest bucket (callers chunk)."""
+        """Smallest bucket >= n, or the largest bucket (callers chunk).
+        On a mesh the per-shard-1 singleton bucket serves ONLY n==1 (its
+        kernel class matches the single-device bucket-1 program; any
+        larger n must land on a per-shard>=2 program — see __init__)."""
+        if self._singleton is not None and n == 1:
+            return self._singleton
         for b in self.buckets:
-            if n <= b:
+            if n <= b and b != self._singleton:
                 return b
         return self.buckets[-1]
 
+    def shard_split(self, n: int):
+        """Per-shard VALID-row counts for an ``n``-image request, after
+        bucket padding (and chunking past the largest bucket) — the split
+        the mesh put lays out: shard ``i`` of a ``b``-bucket batch owns
+        rows ``[i*b/D, (i+1)*b/D)``, so a ragged tail leaves trailing
+        shards partially (or fully) padded. Sums to ``n`` by construction;
+        the batcher feeds these into the ``serve.shard_images`` histogram
+        (shard-occupancy observability)."""
+        out = []
+        cap = self.buckets[-1]
+        for off in range(0, max(int(n), 0), cap):
+            m = min(cap, n - off)
+            per = self.bucket_for(m) // self.n_devices
+            out.extend(
+                min(per, max(0, m - i * per))
+                for i in range(self.n_devices)
+            )
+        return out
+
     # -- inference -----------------------------------------------------
+
+    def _put_batch(self, x: np.ndarray):
+        """Place one padded bucket batch for the compiled program. Mesh:
+        assemble a GLOBAL batch-sharded array (multi-process: each process
+        contributes only its contiguous slab, same plumbing as the train
+        pipeline's ``put_global``); single-device: hand the executable the
+        host array (it does its own transfer, the PR 1 path)."""
+        if self._batch_sharding is None:
+            return x
+        from pytorch_cifar_tpu.data.pipeline import put_sharded_array
+
+        t0 = time.perf_counter()
+        out = put_sharded_array(x, self._batch_sharding)
+        if self._h_put is not None:
+            self._h_put.observe((time.perf_counter() - t0) * 1e3)
+        return out
 
     def _run_bucket(self, x: np.ndarray) -> np.ndarray:
         """One padded executable call: len(x) <= max bucket."""
@@ -300,7 +446,7 @@ class InferenceEngine:
         params, stats = self._weights  # atomic tuple read
         t0 = time.perf_counter()
         with trace.span("serve/bucket_forward", bucket=b, n=n):
-            out = self._compiled[b](params, stats, x)
+            out = self._compiled[b](params, stats, self._put_batch(x))
             res = np.asarray(out)[:n]  # D2H: waits for the execution
         if self._h_device is not None:
             self._h_device.observe((time.perf_counter() - t0) * 1e3)
@@ -331,19 +477,23 @@ class InferenceEngine:
         """Unbatched/unpadded jitted forward at the EXACT request shape —
         the bit-identity oracle for tests and ``serve.py --verify``. Its
         compiles are deliberately not counted in ``compile_count`` (they
-        are verification overhead, not the serving path)."""
+        are verification overhead, not the serving path). On a mesh engine
+        the oracle runs SINGLE-DEVICE (weights pulled to host, default
+        placement): the sharded bucket path must match the one-chip
+        answer, not merely itself."""
         import jax
 
         x = np.asarray(images)
         n = x.shape[0]
+        params, stats = self._weights
+        if self.mesh is not None:
+            params, stats = jax.device_get((params, stats))
         if n not in self._direct:
-            params, stats = self._weights
             self._direct[n] = (
                 jax.jit(self._fwd)
                 .lower(params, stats, jax.numpy.asarray(x))
                 .compile()
             )
-        params, stats = self._weights
         return np.asarray(self._direct[n](params, stats, x))
 
     # -- constructors --------------------------------------------------

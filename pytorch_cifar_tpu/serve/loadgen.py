@@ -7,6 +7,16 @@ latency distribution is the one a real synchronous client would see.
 ``QueueFull`` rejections are counted and retried after a short backoff,
 exercising the admission-control path rather than hiding it.
 
+Deadline hedging (ROBUSTNESS.md "serving retry/hedging"): a request that
+fails with ``DeadlineExceeded`` (its queue-time bound passed during an
+engine stall or a deep backlog) is resubmitted ONCE — the fresh submit
+re-enters the queue at the tail with a fresh deadline, which is exactly
+what a real frontend would do before surfacing the error to the client.
+Hedges are counted (``hedged``, and the ``serve.hedged`` obs counter);
+a request whose hedge also fails is counted in ``failed`` instead of
+crashing the client loop. The retry wait is part of the client-observed
+latency, like the QueueFull backoff.
+
 Shared by ``serve.py`` and ``bench.py --serve`` so the reported p50/p95/p99
 and img/s always mean the same protocol.
 """
@@ -19,7 +29,11 @@ from typing import Optional
 
 import numpy as np
 
-from pytorch_cifar_tpu.serve.batcher import QueueFull
+from pytorch_cifar_tpu.serve.batcher import (
+    BatcherClosed,
+    DeadlineExceeded,
+    QueueFull,
+)
 
 
 def percentile_ms(latencies_ms, pct: float) -> float:
@@ -42,6 +56,7 @@ def run_load(
     seed: int = 0,
     retry_backoff_s: float = 0.002,
     duration_s: Optional[float] = None,
+    hedge: bool = True,
 ) -> dict:
     """Drive ``batcher`` with ``clients`` synchronous synthetic clients.
 
@@ -49,16 +64,34 @@ def run_load(
     realistic serving mix: mostly small requests, padded by the engine).
     Stops after ``requests_per_client`` requests per client, or after
     ``duration_s`` wall seconds when given (whichever comes first).
+    ``hedge``: resubmit a ``DeadlineExceeded`` request once before
+    counting it failed (module docstring; ``--no-hedge`` disables).
 
     Returns the latency/throughput report the CLIs publish:
     ``img_per_sec``, ``request_per_sec``, ``p50_ms``/``p95_ms``/``p99_ms``,
-    ``mean_ms``, ``requests``, ``images``, ``rejected``, ``elapsed_s``.
+    ``mean_ms``, ``requests``, ``images``, ``rejected``, ``hedged``,
+    ``failed``, ``elapsed_s``.
     """
     images_max = max(images_min, images_max)
     latencies_ms: list = []
-    counts = {"images": 0, "rejected": 0}
+    counts = {"images": 0, "rejected": 0, "hedged": 0, "failed": 0}
     lock = threading.Lock()
     stop_at = None
+    # hedges ride the serving registry (when the batcher carries one) so
+    # the Prometheus dump / exporter see retry pressure, not just the CLI
+    obs = getattr(batcher, "obs", None)
+    c_hedged = obs.counter("serve.hedged") if obs is not None else None
+
+    def submit_with_backoff(x):
+        while True:
+            try:
+                return batcher.submit(x)
+            except QueueFull:
+                # admission control said back off; the retry delay is
+                # part of the client-observed latency (t0 stays)
+                with lock:
+                    counts["rejected"] += 1
+                time.sleep(retry_backoff_s)
 
     def client(cid: int) -> None:
         rs = np.random.RandomState(seed * 1000 + cid)
@@ -68,17 +101,30 @@ def run_load(
             n = int(rs.randint(images_min, images_max + 1))
             x = rs.randint(0, 256, size=(n, *image_shape)).astype(np.uint8)
             t0 = time.perf_counter()
-            while True:
-                try:
-                    fut = batcher.submit(x)
-                    break
-                except QueueFull:
-                    # admission control said back off; the retry delay is
-                    # part of the client-observed latency (t0 stays)
+            try:
+                submit_with_backoff(x).result()
+            except DeadlineExceeded:
+                if not hedge:
                     with lock:
-                        counts["rejected"] += 1
-                    time.sleep(retry_backoff_s)
-            fut.result()
+                        counts["failed"] += 1
+                    continue
+                # retry-once hedge: re-enter the queue with a fresh
+                # deadline; a second expiry (or a shutdown race) fails
+                # the request for good — never a third attempt
+                with lock:
+                    counts["hedged"] += 1
+                if c_hedged is not None:
+                    c_hedged.inc()
+                try:
+                    submit_with_backoff(x).result()
+                except (DeadlineExceeded, BatcherClosed):
+                    with lock:
+                        counts["failed"] += 1
+                    continue
+            except BatcherClosed:
+                with lock:
+                    counts["failed"] += 1
+                continue
             dt_ms = (time.perf_counter() - t0) * 1e3
             with lock:
                 latencies_ms.append(dt_ms)
@@ -102,6 +148,8 @@ def run_load(
         "requests": len(latencies_ms),
         "images": counts["images"],
         "rejected": counts["rejected"],
+        "hedged": counts["hedged"],
+        "failed": counts["failed"],
         "elapsed_s": round(elapsed, 4),
         "img_per_sec": counts["images"] / max(elapsed, 1e-9),
         "request_per_sec": len(latencies_ms) / max(elapsed, 1e-9),

@@ -11,6 +11,14 @@ avals, so:
 - a wrong checkpoint (different model trained into the same dir) is
   rejected loudly while serving continues on the previous weights.
 
+Mesh serving needs no extra plumbing here: ``swap_weights`` routes the
+new trees through the engine's own weight placement, which on a mesh
+engine is a REPLICATED ``device_put`` over every chip — so a hot reload
+lands on the whole mesh in the same atomic assignment, and the
+compile-count guarantee (no recompiles on swap) is identical to the
+single-device path (pinned by tests/test_serve.py on the forced-8-device
+CPU host).
+
 **A half-written checkpoint is never served** (ROBUSTNESS.md): the loader
 verifies the sidecar's CRC32/size manifest against the payload before the
 swap, and the watcher re-stats the payload after the read — so a torn
@@ -150,12 +158,17 @@ class CheckpointWatcher:
         self.reloads += 1
         count("reloads")
         trace.instant(
-            "serve/hot_reload", version=version, path=self._path()
+            "serve/hot_reload",
+            version=version,
+            path=self._path(),
+            devices=getattr(self.engine, "n_devices", 1),
         )
         log.info(
-            "hot-reloaded %s -> engine version %d (meta %s)",
+            "hot-reloaded %s -> engine version %d on %d device(s) "
+            "(meta %s)",
             self._path(),
             version,
+            getattr(self.engine, "n_devices", 1),
             meta,
         )
         return True

@@ -331,7 +331,9 @@ def restore_checkpoint(
     candidates = list(names) if names is not None else [name]
     multihost = jax.process_count() > 1
     if multihost:
-        from jax.experimental import multihost_utils
+        # gloo-safe pytree broadcast (chunked on jax 0.4.x CPU, one-shot
+        # everywhere else — parallel/mesh.py has the version gate)
+        from pytorch_cifar_tpu.parallel.mesh import broadcast_pytree
 
     target = {
         "params": jax.device_get(state.params),
@@ -381,9 +383,7 @@ def restore_checkpoint(
     have_ckpt = restored is not None
     if multihost:
         have_ckpt = bool(
-            multihost_utils.broadcast_one_to_all(
-                np.asarray(have_ckpt, np.int32)
-            )
+            broadcast_pytree(np.asarray(have_ckpt, np.int32))
         )
     if not have_ckpt:
         raise FileNotFoundError(
@@ -394,7 +394,7 @@ def restore_checkpoint(
     if restored is None:
         restored = target  # placeholder structure; overwritten by broadcast
     if multihost:
-        restored, scalars = multihost_utils.broadcast_one_to_all(
+        restored, scalars = broadcast_pytree(
             (restored, np.asarray([epoch, best_acc], np.float64))
         )
         epoch, best_acc = int(scalars[0]), float(scalars[1])

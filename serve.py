@@ -7,6 +7,10 @@ The production-shaped entry point for the serving subsystem
   a direct ``.msgpack``, or a reference ``ckpt.pth`` via compat),
 - AOT-compiles one eval-forward program per ``--buckets`` batch size, so
   no request ever compiles after warmup,
+- shards each bucket program's batch axis over the device mesh
+  (``--num_devices``, mirroring train: 0 = all local devices, 1 = the
+  single-chip engine) with weights replicated — serve throughput scales
+  with chips; bucket sizes round to mesh multiples (SERVING.md),
 - coalesces concurrent requests in a bounded-queue micro-batcher, and
 - (``--watch``) hot-reloads newer best checkpoints from the same dir
   without dropping in-flight requests — point it at the output_dir of a
@@ -51,6 +55,7 @@ def main() -> int:
         trace,
     )
     from pytorch_cifar_tpu.obs.export import write_prometheus
+    from pytorch_cifar_tpu.parallel import make_mesh
     from pytorch_cifar_tpu.serve import (
         CheckpointWatcher,
         InferenceEngine,
@@ -71,9 +76,18 @@ def main() -> int:
         jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     )
 
+    # data-parallel serving mesh, mirroring train's --num_devices (0 =
+    # all local devices). A 1-device request keeps the exact single-chip
+    # engine path (no sharded puts, no bucket rounding).
+    mesh = make_mesh(cfg.num_devices)
+    n_devices = int(mesh.devices.size)
+    if n_devices == 1:
+        mesh = None
+
     print(
         f"==> loading {cfg.model} from {cfg.ckpt} "
-        f"(buckets {tuple(cfg.buckets)}, {cfg.dtype}, {platform})",
+        f"(buckets {tuple(cfg.buckets)}, {cfg.dtype}, {platform} "
+        f"x{n_devices})",
         file=sys.stderr,
     )
     engine = InferenceEngine.from_checkpoint(
@@ -85,9 +99,11 @@ def main() -> int:
         mean=cfg.mean,
         std=cfg.std,
         registry=registry,
+        mesh=mesh,
     )
     print(
-        f"==> warm: {engine.compile_count} bucket programs compiled, "
+        f"==> warm: {engine.compile_count} bucket programs compiled "
+        f"(buckets {engine.buckets}, {n_devices} device(s)), "
         f"checkpoint meta {engine.checkpoint_meta}",
         file=sys.stderr,
     )
@@ -95,7 +111,13 @@ def main() -> int:
     if cfg.verify:
         rs = np.random.RandomState(cfg.seed)
         # an off-bucket size, so the padded path is actually exercised
-        n = max(cfg.buckets[0] + 1, 3) if len(cfg.buckets) > 1 else 1
+        # (post-rounding buckets: the mesh may have coarsened cfg.buckets)
+        bks = engine.buckets
+        n = (
+            bks[0] - 1
+            if bks[0] > 1
+            else (bks[1] - 1 if len(bks) > 1 else 1)
+        )
         x = rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
         padded, direct = engine.predict(x), engine.direct_forward(x)
         if not np.array_equal(padded, direct):
@@ -145,6 +167,7 @@ def main() -> int:
             images_max=cfg.request_images_max,
             seed=cfg.seed,
             duration_s=cfg.duration_s or None,
+            hedge=cfg.hedge,
         )
     finally:
         if watcher is not None:
@@ -167,11 +190,16 @@ def main() -> int:
         "ckpt": cfg.ckpt,
         "platform": platform,
         "dtype": cfg.dtype,
+        # multi-chip serving (SERVING.md): devices the mesh spans plus
+        # per-chip throughput, so serve numbers land next to the train
+        # metric (images/sec/chip) in the MULTICHIP series
+        "n_devices": n_devices,
         "buckets": list(engine.buckets),
         "max_batch": batcher.max_batch,
         "max_wait_ms": cfg.max_wait_ms,
         "compiles": compiles_after,
         "engine_version": engine.version,
+        "ckpt_epoch": engine.checkpoint_meta.get("epoch"),
         "reloads": watcher.reloads if watcher is not None else 0,
         "reload_skipped": watcher.skipped if watcher is not None else 0,
         "batches": batcher.stats["batches"],
@@ -182,6 +210,9 @@ def main() -> int:
             k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in report.items()
         },
+        "img_per_sec_per_chip": round(
+            report["img_per_sec"] / max(n_devices, 1), 3
+        ),
         # registry-derived health block: queue/occupancy/latency from the
         # same counters the exporter and Prometheus dump publish
         "obs": {
@@ -195,7 +226,12 @@ def main() -> int:
             "device_p95_ms": round(
                 obs_summary.get("serve.device_ms.p95", 0.0), 3
             ),
+            # sharded-batch assembly time (mesh engines; 0 single-chip)
+            "put_p95_ms": round(
+                obs_summary.get("serve.put_ms.p95", 0.0), 3
+            ),
             "expired": obs_summary.get("serve.expired", 0.0),
+            "hedged": obs_summary.get("serve.hedged", 0.0),
             "reloads": obs_summary.get("serve.reload.reloads", 0.0),
         },
     }

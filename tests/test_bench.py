@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -161,9 +163,12 @@ def test_bench_epoch_mode_prints_one_json_line():
 
 
 def test_bench_serve_mode_prints_one_json_line():
-    """--serve (round 6): closed-loop serving latency through the bucket-
-    compiled engine + micro-batcher; the single JSON line carries the
-    driver contract keys PLUS the latency SLO percentiles."""
+    """--serve (round 6; mesh-native since the multi-chip serving PR):
+    closed-loop serving latency through the bucket-compiled engine +
+    micro-batcher, sharded over every local device — on this forced-
+    8-device host the record must report n_devices=8 with per-chip
+    throughput (the MULTICHIP serve acceptance pin) alongside the driver
+    contract keys and the latency SLO percentiles."""
     rec, _ = run_bench(
         ["--model", "LeNet", "--serve", "--steps", "2", "--batch", "16"]
     )
@@ -171,17 +176,30 @@ def test_bench_serve_mode_prints_one_json_line():
     assert rec["metric"].startswith("serve_throughput_LeNet_b16"), rec
     assert rec["metric"].endswith("_cpu"), rec["metric"]
     assert rec["value"] > 0
+    # mesh serving: `value` is TOTAL mesh throughput, not per-chip
+    assert rec["unit"] == "images/sec"
+    # the sharded engine ran on the whole forced-device mesh, and the
+    # per-chip number divides the total by exactly that count
+    assert rec["n_devices"] == 8
+    assert rec["img_per_sec_per_chip"] == pytest.approx(
+        rec["value"] / 8, rel=0.01
+    )
+    assert rec["hedged"] == 0  # no deadlines armed -> nothing to hedge
     assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
     assert rec["p95_ms"] >= rec["p50_ms"]
     assert rec["rejected"] >= 0 and rec["requests"] > 0
     # serving-side obs block: queue pressure + expiry health from the
-    # batcher's registry (OBSERVABILITY.md)
-    assert {"queue_depth_max", "deadline_expired", "latency_p95_ms"} <= (
-        set(rec["obs"])
-    )
+    # batcher's registry (OBSERVABILITY.md), plus the mesh put timing
+    # and per-shard occupancy added by the multi-chip serving PR
+    assert {
+        "queue_depth_max", "deadline_expired", "latency_p95_ms",
+        "put_p95_ms", "shard_images_mean",
+    } <= set(rec["obs"])
     assert rec["obs"]["queue_depth_max"] >= 1
     assert rec["obs"]["deadline_expired"] == 0.0  # no deadlines armed
     assert rec["obs"]["latency_p95_ms"] > 0
+    assert rec["obs"]["put_p95_ms"] > 0  # sharded puts actually ran
+    assert rec["obs"]["shard_images_mean"] > 0
 
 
 def test_parse_child_record_skips_non_record_json_lines():

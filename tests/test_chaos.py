@@ -107,3 +107,21 @@ def test_bench_chaos_smoke_contract(tmp_path):
     assert rec["unit"] == "seconds"
     assert rec["value"] > 0 and rec["match"] is True
     assert "vs_baseline" in rec
+
+
+def test_sharded_serve_drill_hot_reload_and_kill(tmp_path):
+    """--mode serve (SERVING.md multi-chip): the mesh serving process
+    hot-reloads a newly published checkpoint under load (no failed
+    requests), survives a SIGKILL mid-load, and the relaunch serves the
+    NEW best checkpoint over the full forced-8-device mesh with the
+    compile count pinned."""
+    rec = run_chaos(
+        "serve", tmp_path,
+        extra=("--serve-devices", "8", "--epochs", "2"),
+    )
+    assert rec["match"] is True
+    assert rec["reloads"] >= 1
+    assert rec["n_devices"] == 8
+    assert rec["ckpt_epoch_served"] == rec["ckpt_epoch_published"]
+    assert rec["killed_rc"] == -9
+    assert rec["recovery_s"] > 0

@@ -489,6 +489,255 @@ def test_swap_rejects_mismatched_weights(tmp_path):
     assert eng.predict(x).shape == (1, 10)  # still serving
 
 
+# -- multi-chip serving (mesh engine; forced-8-device CPU host) ---------
+
+
+def _lenet_weights(seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.models import create_model
+
+    model = create_model("LeNet")
+    variables = model.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, 32, 32, 3), jnp.float32),
+        train=False,
+    )
+    return dict(variables["params"]), dict(variables.get("batch_stats", {}))
+
+
+@pytest.fixture(scope="module")
+def mesh_engine_pair():
+    """The same LeNet weights behind a single-device engine and an
+    8-device mesh engine — the topology-parity pair the multi-chip
+    acceptance criterion compares."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    p, s = _lenet_weights()
+    single = InferenceEngine(
+        "LeNet", p, s, buckets=(1, 8, 16), compute_dtype=jnp.float32
+    )
+    sharded = InferenceEngine(
+        "LeNet", p, s, buckets=(1, 8, 16), compute_dtype=jnp.float32,
+        mesh=make_mesh(),
+    )
+    return single, sharded
+
+
+def test_round_buckets_rule():
+    """The mesh bucket-rounding rule (SERVING.md): round UP to multiples,
+    dedupe, never round down."""
+    from pytorch_cifar_tpu.serve.engine import round_buckets
+
+    assert round_buckets((1, 8, 32, 128), 8) == (8, 32, 128)
+    assert round_buckets((3, 5), 4) == (4, 8)
+    assert round_buckets((8,), 1) == (8,)
+    assert round_buckets((7, 8, 9), 8) == (8, 16)
+
+
+def test_mesh_engine_rounds_buckets_and_keeps_singleton(mesh_engine_pair):
+    """8-device engine: buckets round to mesh multiples with a per-shard
+    extent >= 2 floor, and the configured 1-bucket survives as the
+    per-shard-1 singleton used only by n==1 (engine.py has the measured
+    kernel-class rationale)."""
+    _, sharded = mesh_engine_pair
+    assert sharded.n_devices == 8
+    assert sharded.buckets == (8, 16)
+    assert sharded.compile_count == len(sharded.buckets)
+    assert sharded.bucket_for(1) == 8  # singleton
+    for n in (2, 5, 8, 16):
+        assert sharded.bucket_for(n) == 16  # never the singleton
+
+
+def test_sharded_engine_bit_identical_to_single_device(mesh_engine_pair):
+    """THE multi-chip acceptance pin: for identical weights and batches,
+    the mesh engine's logits are bit-identical to the single-device
+    engine's — across padding, the singleton path, and chunking."""
+    single, sharded = mesh_engine_pair
+    for n in (1, 2, 3, 5, 8, 11, 16, 19, 33):
+        x = _images(n, seed=100 + n)
+        a, b = single.predict(x), sharded.predict(x)
+        assert a.shape == b.shape == (n, 10)
+        assert np.array_equal(a, b), f"n={n} diverged across topologies"
+
+
+def test_sharded_engine_matches_direct_oracle(mesh_engine_pair):
+    """Sharded predict vs the single-device direct-forward oracle at the
+    exact request shape (the --verify contract under a mesh). n values
+    avoid a trailing 1-row chunk, where the (pre-existing, single-device
+    too) bucket-1 kernel class legitimately differs from a batch-n
+    oracle."""
+    _, sharded = mesh_engine_pair
+    for n in (1, 2, 7, 11, 16, 19):
+        x = _images(n, seed=200 + n)
+        assert np.array_equal(
+            sharded.predict(x), sharded.direct_forward(x)
+        ), f"n={n}"
+
+
+def test_sharded_engine_no_recompile_any_size(mesh_engine_pair):
+    _, sharded = mesh_engine_pair
+    before = sharded.compile_count
+    for n in (1, 2, 5, 9, 17, 40):
+        assert sharded.predict(_images(n, seed=n)).shape == (n, 10)
+    assert sharded.compile_count == before
+
+
+def test_shard_split_ragged_and_padded():
+    """shard_split: per-shard valid-row counts sum to n, never exceed the
+    per-shard bucket capacity, and lay ragged tails on the leading
+    shards (trailing shards carry the padding)."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    p, s = _lenet_weights()
+    eng = InferenceEngine(
+        "LeNet", p, s, buckets=(1, 8, 16), compute_dtype=jnp.float32,
+        mesh=make_mesh(), warmup=False,
+    )
+    assert eng.shard_split(1) == [1, 0, 0, 0, 0, 0, 0, 0]  # singleton
+    assert eng.shard_split(11) == [2, 2, 2, 2, 2, 1, 0, 0]
+    assert eng.shard_split(16) == [2] * 8
+    # chunked past the largest bucket: 16 + 3
+    assert eng.shard_split(19) == [2] * 8 + [2, 1, 0, 0, 0, 0, 0, 0]
+    for n in (1, 2, 5, 7, 8, 11, 13, 16, 19, 33):
+        split = eng.shard_split(n)
+        assert sum(split) == n
+        assert all(c >= 0 for c in split)
+
+
+def test_batcher_rounds_max_batch_and_tracks_shard_occupancy(
+    mesh_engine_pair,
+):
+    """Batcher over a mesh engine: max_batch rounds up to the shard
+    multiple, ragged coalesced batches serve bit-exact, and the
+    serve.shard_images histogram sees one sample per shard per batch."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    _, sharded = mesh_engine_pair
+    b = MicroBatcher(
+        sharded, max_batch=11, max_wait_ms=50, max_queue=64,
+        autostart=False,
+    )
+    assert b.max_batch == 16  # rounded up to the 8-shard multiple
+    xs = [_images(3, seed=i) for i in range(3)]  # 9 images: ragged batch
+    futs = [b.submit(x) for x in xs]
+    b.start()
+    outs = [f.result(timeout=120) for f in futs]
+    b.close()
+    assert b.stats["batches"] == 1
+    full = sharded.direct_forward(np.concatenate(xs, axis=0))
+    off = 0
+    for out in outs:
+        assert np.array_equal(out, full[off : off + 3])
+        off += 3
+    # 9 images over 8 shards of the 16-bucket: one observation per shard
+    h = b.obs.histogram("serve.shard_images").snapshot()
+    assert h["count"] == 8
+    assert h["max"] == 2  # [2,2,2,2,1,0,0,0]
+
+
+def test_sharded_hot_reload_no_recompile(tmp_path):
+    """Satellite pin: hot-reload on the mesh engine swaps weights on
+    every shard atomically with ZERO new compiles, and post-swap sharded
+    outputs match the new weights' single-device oracle."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import CheckpointWatcher, InferenceEngine
+
+    _save_lenet_checkpoint(tmp_path, seed=0, epoch=1, best_acc=10.0)
+    eng = InferenceEngine.from_checkpoint(
+        str(tmp_path), "LeNet", buckets=(4,), compute_dtype=jnp.float32,
+        mesh=make_mesh(),
+    )
+    assert eng.buckets == (16,)  # 2*8 floor
+    compiles = eng.compile_count
+    assert compiles == 1
+    watcher = CheckpointWatcher(eng, str(tmp_path), poll_s=3600)
+    x = _images(5, seed=1)
+    before = eng.predict(x)
+    _save_lenet_checkpoint(tmp_path, seed=7, epoch=2, best_acc=20.0)
+    assert watcher.poll_once() is True
+    after = eng.predict(x)
+    assert eng.version == 1 and watcher.reloads == 1
+    assert eng.compile_count == compiles  # the compile-count guarantee
+    assert not np.array_equal(before, after)
+    assert np.array_equal(after, eng.direct_forward(x))
+
+
+# -- deadline hedging (loadgen) ------------------------------------------
+
+
+class _FlakyDeadlineBatcher:
+    """submit() alternates DeadlineExceeded / success — deterministic
+    harness for the loadgen retry-once hedge (no threads, no timing)."""
+
+    def __init__(self, fail_every: int = 2):
+        from concurrent.futures import Future
+
+        from pytorch_cifar_tpu.obs import MetricsRegistry
+
+        self.obs = MetricsRegistry()
+        self.calls = 0
+        self.fail_every = fail_every
+        self._Future = Future
+
+    def submit(self, images):
+        from pytorch_cifar_tpu.serve import DeadlineExceeded
+
+        self.calls += 1
+        f = self._Future()
+        if self.fail_every == 1 or self.calls % self.fail_every == 1:
+            f.set_exception(DeadlineExceeded("expired while queued"))
+        else:
+            f.set_result(np.zeros((images.shape[0], 10), np.float32))
+        return f
+
+
+def test_loadgen_hedges_deadline_exceeded_once():
+    """Every first attempt expires, every hedge succeeds: all requests
+    complete, `hedged` counts each retry, the serve.hedged counter
+    matches, and nothing is failed."""
+    from pytorch_cifar_tpu.serve.loadgen import run_load
+
+    b = _FlakyDeadlineBatcher(fail_every=2)
+    rep = run_load(b, clients=1, requests_per_client=4, seed=0)
+    assert rep["requests"] == 4
+    assert rep["hedged"] == 4
+    assert rep["failed"] == 0
+    assert b.obs.counter("serve.hedged").value == 4
+    assert b.calls == 8  # one hedge per request, never a third attempt
+
+
+def test_loadgen_hedge_failure_counted_not_raised():
+    """Hedge also expires -> the request is counted failed; the client
+    loop never surfaces the exception (the error-containment half)."""
+    from pytorch_cifar_tpu.serve.loadgen import run_load
+
+    b = _FlakyDeadlineBatcher(fail_every=1)  # every attempt expires
+    rep = run_load(b, clients=1, requests_per_client=3, seed=0)
+    assert rep["requests"] == 0
+    assert rep["hedged"] == 3
+    assert rep["failed"] == 3
+    assert b.calls == 6  # exactly one hedge per request
+
+
+def test_loadgen_no_hedge_flag_fails_fast():
+    from pytorch_cifar_tpu.serve.loadgen import run_load
+
+    b = _FlakyDeadlineBatcher(fail_every=1)
+    rep = run_load(b, clients=1, requests_per_client=3, seed=0, hedge=False)
+    assert rep["hedged"] == 0 and rep["failed"] == 3
+    assert b.calls == 3  # no retries at all
+
+
 # -- config + load generator --------------------------------------------
 
 
@@ -501,6 +750,12 @@ def test_parse_serve_config_buckets_and_defaults():
     assert cfg.buckets == (1, 4)
     assert cfg.max_wait_ms == 5.0
     assert parse_serve_config([]).buckets == (1, 8, 32, 128)
+    # mesh + hedging flags (multi-chip serving PR): defaults mirror train
+    # (0 = all local devices) with the retry-once hedge armed
+    assert parse_serve_config([]).num_devices == 0
+    assert parse_serve_config([]).hedge is True
+    cfg = parse_serve_config(["--num_devices", "2", "--no-hedge"])
+    assert cfg.num_devices == 2 and cfg.hedge is False
 
 
 def test_loadgen_reports_latency_percentiles(lenet_engine):
@@ -559,7 +814,10 @@ def test_resnet18_checkpoint_serving_bit_identical(tmp_path):
 def test_serve_cli_end_to_end(tmp_path):
     """python serve.py --ckpt <dir> --model LeNet answers concurrent
     synthetic requests with verified bit-identity (--verify), hot-reload
-    armed (--watch), and prints ONE JSON line on stdout."""
+    armed (--watch), and prints ONE JSON line on stdout. Mesh-native
+    default (--num_devices 0): on this forced-8-device host the engine
+    shards over all 8, rounds the buckets to mesh multiples, and reports
+    n_devices + per-chip throughput in the JSON contract."""
     _save_lenet_checkpoint(tmp_path, seed=0, epoch=4, best_acc=55.0)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     r = subprocess.run(
@@ -579,8 +837,34 @@ def test_serve_cli_end_to_end(tmp_path):
     assert len(lines) == 1, r.stdout
     rec = json.loads(lines[0])
     assert rec["model"] == "LeNet"
-    assert rec["compiles"] == 3  # one per bucket, nothing after warmup
+    assert rec["n_devices"] == 8
+    # (1, 4, 8) rounds to the mesh rule: singleton 8 + 2*8 floor
+    assert rec["buckets"] == [8, 16]
+    assert rec["compiles"] == 2  # one per bucket, nothing after warmup
+    assert rec["ckpt_epoch"] == 4
     assert rec["requests"] == 16 and rec["rejected"] == 0
+    assert rec["failed"] == 0
     assert rec["img_per_sec"] > 0
+    assert rec["img_per_sec_per_chip"] == pytest.approx(
+        rec["img_per_sec"] / 8, rel=0.01
+    )
     assert 0 < rec["p50_ms"] <= rec["p99_ms"]
     assert "bit-identical" in r.stderr
+
+    # --num_devices 1 keeps the exact single-chip engine (no rounding)
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--ckpt", str(tmp_path), "--model", "LeNet",
+            "--buckets", "1", "4",
+            "--clients", "2", "--requests", "2",
+            "--num_devices", "1",
+        ],
+        capture_output=True, text=True, timeout=560, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    rec = json.loads(
+        [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")][0]
+    )
+    assert rec["n_devices"] == 1
+    assert rec["buckets"] == [1, 4] and rec["compiles"] == 2

@@ -20,11 +20,21 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
   nan      — divergence drill: PCT_FAULTS=nan_loss=K poisons the loss at
              one step under --sentinel skip; the run must finish finite
              and land within float32 tolerance of the reference run.
+  serve    — sharded-serving drill (SERVING.md multi-chip): a mesh
+             serving process (serve.py over --serve-devices forced CPU
+             devices, --watch armed) must hot-reload a newly published
+             checkpoint UNDER LOAD; a second serving process is then
+             SIGKILLed mid-load, and the relaunch must come back serving
+             the NEW best checkpoint on the full mesh (recovery_s =
+             relaunch-to-completion). No weight bits may be dropped:
+             the relaunched server's ckpt_epoch must equal the published
+             checkpoint's epoch and its compile count must stay pinned.
 
 Usage:
   python tools/chaos_run.py --mode sigterm
   python tools/chaos_run.py --mode corrupt --corruption bitflip
   python tools/chaos_run.py --mode nan --epochs 3
+  python tools/chaos_run.py --mode serve --serve-devices 8
 
 Subprocess-only: this driver never initializes a jax backend (the child
 runs own the device); comparisons read the msgpack checkpoints directly.
@@ -179,11 +189,194 @@ def compare(dir_a: str, dir_b: str) -> dict:
     }
 
 
+def _publish_checkpoint(src_dir: str, dst_dir: str) -> None:
+    """Publish src_dir's best checkpoint into dst_dir the way the trainer
+    does: payload first, then sidecar, each via tmp+rename — so a watcher
+    polling dst_dir can never read a torn pair."""
+    import shutil
+
+    for name in ("ckpt.msgpack", "ckpt.json"):
+        src = os.path.join(src_dir, name)
+        dst = os.path.join(dst_dir, name)
+        tmp = dst + f".tmp.{os.getpid()}"
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dst)
+
+
+def _serve_record(stdout: str):
+    """The single JSON line serve.py prints on stdout (None if absent)."""
+    rec = None
+    for ln in stdout.splitlines():
+        s = ln.strip()
+        if s.startswith("{"):
+            try:
+                cand = json.loads(s)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and "img_per_sec" in cand:
+                rec = cand
+    return rec
+
+
+def _wait_for_stderr(proc, needle: str, timeout: float) -> str:
+    """Consume proc.stderr lines until one contains ``needle``; returns
+    everything read. Raises SystemExit on EOF/timeout (the child died or
+    wedged before reaching the awaited phase)."""
+    deadline = time.monotonic() + timeout
+    seen = []
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"serve child exited rc={proc.returncode} before "
+                    f"{needle!r}:\n" + "".join(seen)[-3000:]
+                )
+            time.sleep(0.05)
+            continue
+        seen.append(line)
+        if needle in line:
+            return "".join(seen)
+    proc.kill()
+    raise SystemExit(f"timed out waiting for {needle!r} on serve stderr")
+
+
+def serve_drill(args, work: str) -> dict:
+    """The sharded-serving drill (module docstring): hot-reload under
+    load, then SIGKILL under load, then relaunch onto the NEW checkpoint
+    over the full forced-device mesh."""
+    dir_a = os.path.join(work, "ckpt_a")
+    dir_b = os.path.join(work, "ckpt_b")
+    serve_dir = os.path.join(work, "serving")
+    os.makedirs(serve_dir, exist_ok=True)
+
+    env = child_env()
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count="
+            f"{args.serve_devices}"
+        ).strip()
+
+    def serve_cmd(watch: bool, clients: int, requests: int,
+                  duration_s: float = 0.0):
+        cmd = [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--ckpt", serve_dir,
+            "--model", args.model,
+            "--buckets", "1", "4", "8",
+            "--clients", str(clients),
+            "--requests", str(requests),
+            "--poll_s", "0.2",
+        ]
+        if duration_s:
+            cmd += ["--duration_s", str(duration_s)]
+        if watch:
+            cmd.append("--watch")
+        return cmd
+
+    # two distinct checkpoints: A is served first, B is published into
+    # the watched dir mid-load (different seed -> different weights)
+    print(f"==> [serve] training checkpoint A -> {dir_a}", file=sys.stderr)
+    run_to_completion(train_cmd(args, dir_a), child_env(), args.timeout)
+    args_b = argparse.Namespace(**{**vars(args), "seed": args.seed + 1})
+    print(f"==> [serve] training checkpoint B -> {dir_b}", file=sys.stderr)
+    run_to_completion(train_cmd(args_b, dir_b), child_env(), args.timeout)
+    epoch_b = json.load(open(os.path.join(dir_b, "ckpt.json")))["epoch"]
+    _publish_checkpoint(dir_a, serve_dir)
+
+    # phase 1 — hot-reload under load: the watcher must pick up B while
+    # synthetic clients hammer the mesh engine, without a failed request
+    print("==> [serve] phase 1: hot-reload under load", file=sys.stderr)
+    proc = subprocess.Popen(
+        serve_cmd(watch=True, clients=4, requests=10**6, duration_s=8.0),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+    t1 = time.monotonic()
+    _wait_for_stderr(proc, "watching", args.timeout)
+    time.sleep(0.5)  # load is now running against checkpoint A
+    _publish_checkpoint(dir_b, serve_dir)
+    try:
+        out, err = proc.communicate(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise SystemExit("phase-1 serve run did not finish")
+    phase1_s = time.monotonic() - t1
+    rec1 = _serve_record(out)
+    if proc.returncode != 0 or rec1 is None:
+        sys.stderr.write(err[-4000:])
+        raise SystemExit(
+            f"phase-1 serve run failed rc={proc.returncode}"
+        )
+
+    # phase 2 — kill under load: a mesh serving process dies hard; the
+    # drill only requires that this never corrupts the watched dir
+    print("==> [serve] phase 2: SIGKILL under load", file=sys.stderr)
+    proc = subprocess.Popen(
+        serve_cmd(watch=True, clients=2, requests=10**6, duration_s=60.0),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+    _wait_for_stderr(proc, "watching", args.timeout)
+    time.sleep(args.kill_delay_s)
+    proc.send_signal(signal.SIGKILL)
+    proc.communicate(timeout=args.timeout)
+    killed_rc = proc.returncode
+
+    # phase 3 — recovery: a fresh mesh server must come up on the NEW
+    # best checkpoint (B), full device count, compile count pinned
+    print("==> [serve] phase 3: relaunch + verify", file=sys.stderr)
+    t0 = time.monotonic()
+    r = subprocess.run(
+        serve_cmd(watch=False, clients=2, requests=4),
+        env=env, capture_output=True, text=True, timeout=args.timeout,
+        cwd=REPO,
+    )
+    recovery_s = time.monotonic() - t0
+    rec3 = _serve_record(r.stdout)
+    if r.returncode != 0 or rec3 is None:
+        sys.stderr.write(r.stderr[-4000:])
+        raise SystemExit(f"phase-3 serve run failed rc={r.returncode}")
+
+    ok = (
+        rec1["reloads"] >= 1
+        and rec1["failed"] == 0
+        and rec1["requests"] > 0
+        and rec1["n_devices"] == args.serve_devices
+        and killed_rc == -int(signal.SIGKILL)
+        and rec3["ckpt_epoch"] == epoch_b
+        and rec3["n_devices"] == args.serve_devices
+        and rec3["compiles"] == len(rec3["buckets"])
+        and rec3["requests"] > 0
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "serve",
+        "match": ok,
+        "reference_s": round(phase1_s, 2),
+        "recovery_s": round(recovery_s, 2),
+        "reloads": rec1["reloads"],
+        "hedged": rec1["hedged"],
+        "n_devices": rec3["n_devices"],
+        "ckpt_epoch_published": epoch_b,
+        "ckpt_epoch_served": rec3["ckpt_epoch"],
+        "compiles": rec3["compiles"],
+        "killed_rc": killed_rc,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
-        "--mode", choices=("sigterm", "sigkill", "corrupt", "nan"),
+        "--mode", choices=("sigterm", "sigkill", "corrupt", "nan", "serve"),
         default="sigterm",
+    )
+    p.add_argument(
+        "--serve-devices", type=int, default=8, dest="serve_devices",
+        help="forced CPU device count for the --mode serve mesh drill",
     )
     p.add_argument(
         "--corruption", choices=("truncate", "bitflip"), default="truncate",
@@ -222,6 +415,18 @@ def main() -> int:
     )
 
     work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
+
+    if args.mode == "serve":
+        record = serve_drill(args, work)
+        print(json.dumps(record))
+        if record["match"] and not args.out:
+            import shutil
+
+            shutil.rmtree(work, ignore_errors=True)
+        elif not record["match"]:
+            print(f"==> artifacts kept in {work}", file=sys.stderr)
+        return 0 if record["match"] else 1
+
     dir_ref = os.path.join(work, "reference")
     dir_chaos = os.path.join(work, "chaos")
 
