@@ -119,9 +119,13 @@ def make_train_step(
         if axis_name is not None:
             # decorrelate augmentation across data-parallel shards
             key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        # independent subkeys: the augmentation offsets and the model's
+        # "stochastic" rng stream (stochastic depth) must not draw from
+        # identical bits (graftcheck prng-reuse)
+        k_aug, k_model = jax.random.split(key)
         if augment:
             x = augment_batch(
-                key, images, crop=crop, flip=flip, mean=mean, std=std,
+                k_aug, images, crop=crop, flip=flip, mean=mean, std=std,
                 dtype=compute_dtype,
             )
         else:
@@ -139,7 +143,7 @@ def make_train_step(
             fwd = jax.checkpoint(fwd)
 
         def loss_fn(params):
-            logits, mutated = fwd(params, x, key)
+            logits, mutated = fwd(params, x, k_model)
             loss_sum, n_valid = cross_entropy_sums(logits, labels)
             if axis_name is None:
                 loss = loss_sum / jnp.maximum(n_valid, 1)
