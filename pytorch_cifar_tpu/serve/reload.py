@@ -73,6 +73,11 @@ class CheckpointWatcher:
         # health without callers polling watcher attributes
         self._obs = registry
         self._stop = threading.Event()
+        # guards the observable stats (reloads/errors/skipped/last_meta)
+        # and the thread handle: the poll thread mutates them while the
+        # serving CLI and tests read them (graftcheck
+        # unlocked-shared-mutation)
+        self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         # baseline signature: whatever is on disk NOW is what the engine
         # was (presumably) loaded from; only a LATER write triggers a swap
@@ -119,7 +124,8 @@ class CheckpointWatcher:
             # sidecar right after the payload); a permanently corrupt
             # file just keeps being skipped, never served
             log.warning("skipping torn/corrupt checkpoint: %s", e)
-            self.skipped += 1
+            with self._lock:
+                self.skipped += 1
             count("skipped")
             return False
         except Exception:
@@ -127,9 +133,10 @@ class CheckpointWatcher:
             # read); remember the signature so a permanently broken file
             # isn't re-read every poll
             log.exception("checkpoint reload failed (%s)", self._path())
-            self.errors += 1
+            with self._lock:
+                self.errors += 1
+                self._last_sig = sig
             count("errors")
-            self._last_sig = sig
             return False
         if self._signature() != sig:
             # payload replaced while we were reading the pair: the meta
@@ -140,7 +147,8 @@ class CheckpointWatcher:
                 "checkpoint %s republished mid-read; deferring swap one "
                 "poll", self._path(),
             )
-            self.skipped += 1
+            with self._lock:
+                self.skipped += 1
             count("skipped")
             return False
         try:
@@ -149,13 +157,15 @@ class CheckpointWatcher:
             # wrong-model checkpoint: keep serving the previous weights;
             # remember the signature so it isn't re-tried every poll
             log.exception("checkpoint swap rejected (%s)", self._path())
-            self.errors += 1
+            with self._lock:
+                self.errors += 1
+                self._last_sig = sig
             count("errors")
-            self._last_sig = sig
             return False
-        self._last_sig = sig
-        self.last_meta = meta
-        self.reloads += 1
+        with self._lock:
+            self._last_sig = sig
+            self.last_meta = meta
+            self.reloads += 1
         count("reloads")
         trace.instant(
             "serve/hot_reload",
@@ -178,19 +188,24 @@ class CheckpointWatcher:
             self.poll_once()
 
     def start(self) -> "CheckpointWatcher":
-        if self._thread is None or not self._thread.is_alive():
-            self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._run, name="ckpt-watcher", daemon=True
-            )
-            self._thread.start()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="ckpt-watcher", daemon=True
+                )
+                self._thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join()
+        # take the handle under the lock, join OUTSIDE it: a concurrent
+        # start() must not block for a whole poll interval on the join
+        with self._lock:
+            t = self._thread
             self._thread = None
+        if t is not None:
+            t.join()
 
     def __enter__(self):
         return self.start()
