@@ -199,11 +199,17 @@ class MicroBatcher:
     # -- worker side ---------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(
-                target=self._worker, name="micro-batcher", daemon=True
-            )
-            self._thread.start()
+        # the thread handle is shared with close() — taking the condition
+        # here makes a concurrent start/close pair see one consistent
+        # worker instead of racing the is_alive check (graftcheck
+        # unlocked-shared-mutation). The nascent worker just blocks on
+        # this same condition in _take_batch until start() releases it.
+        with self._cond:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._worker, name="micro-batcher", daemon=True
+                )
+                self._thread.start()
 
     def _fail_expired_locked(self) -> None:
         """Fail every queued request whose deadline has passed (caller
