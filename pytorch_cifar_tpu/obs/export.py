@@ -45,17 +45,23 @@ class MetricsExporter:
         self._seq = 0
         self._t0 = time.monotonic()
         self._stop = threading.Event()
+        # guards the sequence counter and the thread handle: the export
+        # thread and stop()'s final-line write share both (graftcheck
+        # unlocked-shared-mutation)
+        self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     def _write_line(self) -> None:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
         line = json.dumps(
             {
                 "ts_s": round(time.monotonic() - self._t0, 3),
-                "seq": self._seq,
+                "seq": seq,
                 "metrics": self.registry.snapshot(),
             }
         )
-        self._seq += 1
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
         with open(self.path, "a") as f:
@@ -70,19 +76,24 @@ class MetricsExporter:
                 pass
 
     def start(self) -> "MetricsExporter":
-        if self._thread is None or not self._thread.is_alive():
-            self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._run, name="metrics-exporter", daemon=True
-            )
-            self._thread.start()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="metrics-exporter", daemon=True
+                )
+                self._thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join()
+        # take the handle under the lock, join outside it (a concurrent
+        # start() must not wait a full interval behind the join)
+        with self._lock:
+            t = self._thread
             self._thread = None
+        if t is not None:
+            t.join()
         try:
             self._write_line()  # final snapshot even for sub-interval runs
         except OSError:
