@@ -1,0 +1,33 @@
+"""graftcheck — a JAX-aware static-analysis pass for this repo.
+
+Four PRs in, every hard bug in this codebase has been an *invariant
+violation no unit test caught until runtime*: the seed suite hard-aborting
+on unprobed XLA flags, the persistent compile cache mis-executing donated
+buffers, gloo aborting on variable-size broadcasts, trace-time-only side
+effects. Production stacks encode such invariants in a custom lint layer
+so regressions are caught at review time; this package is that layer.
+
+- :mod:`pytorch_cifar_tpu.lint.engine` — the rule runner: file walking,
+  inline suppressions (``# graftcheck: noqa[rule] -- reason``), baseline
+  matching, JSON/human output.
+- :mod:`pytorch_cifar_tpu.lint.rules` — the rules themselves, each
+  grounded in a failure mode this repo has actually hit (the catalog with
+  one real-world example per rule is STATIC_ANALYSIS.md).
+
+CLI: ``python tools/lint.py`` (``--changed`` for the pre-commit inner
+loop). Tier-1 enforcement: tests/test_lint.py runs the full engine over
+``pytorch_cifar_tpu/`` and asserts zero unsuppressed findings.
+"""
+
+from pytorch_cifar_tpu.lint.engine import (  # noqa: F401
+    BaselineError,
+    Finding,
+    LintRun,
+    collect_python_files,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    match_baseline,
+    write_baseline,
+)
+from pytorch_cifar_tpu.lint.rules import RULES, rule_names  # noqa: F401

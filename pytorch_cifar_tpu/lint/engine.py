@@ -1,0 +1,461 @@
+"""graftcheck engine: parse once, run rules, suppress, baseline, report.
+
+Design constraints:
+
+- **Pure stdlib, pure AST.** The engine never imports the modules it
+  lints (importing would execute them — and half the tree initializes a
+  jax backend at import time). Everything is ``ast`` + ``tokenize``.
+- **Suppressions carry a reason.** ``# graftcheck: noqa[rule] -- reason``
+  on the finding's first line (or the line above, for comment-above
+  style). A noqa with no reason, or naming an unknown rule, is itself a
+  finding (rule ``suppression``) — a silent mute is exactly the
+  grandfathering-without-accountability this layer exists to prevent.
+- **Baseline = grandfathered findings, keyed by content.** Fingerprints
+  hash (rule, basename, normalized source line, occurrence index), so
+  they survive line moves and reformats but expire when the flagged code
+  changes — a stale entry is reported so the baseline cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# engine-level diagnostic "rule" for malformed suppressions; always
+# reported (a bad noqa cannot noqa itself)
+SUPPRESSION_RULE = "suppression"
+# unparseable file: reported as a finding so the CLI exits 1, not 2 — a
+# syntax error in LINTED code is a code problem, not a usage problem
+PARSE_RULE = "parse-error"
+
+_NOQA_RE = re.compile(
+    r"#\s*graftcheck:\s*noqa\[(?P<rules>[^\]]*)\]"
+    r"(?:\s*--\s*(?P<reason>\S.*))?"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative (or as-given) path, for stable output
+    line: int
+    col: int
+    message: str
+    fingerprint: str = ""
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+
+    @property
+    def status(self) -> str:
+        if self.suppressed:
+            return "suppressed"
+        if self.baselined:
+            return "baselined"
+        return "open"
+
+    def to_json(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "status": self.status,
+        }
+        if self.suppress_reason:
+            d["reason"] = self.suppress_reason
+        return d
+
+    def render(self) -> str:
+        tag = ""
+        if self.suppressed:
+            tag = " (suppressed: %s)" % self.suppress_reason
+        elif self.baselined:
+            tag = " (baselined)"
+        return "%s:%d:%d: [%s] %s%s" % (
+            self.path, self.line, self.col, self.rule, self.message, tag
+        )
+
+
+class ModuleCtx:
+    """One parsed file handed to every rule: path, source, AST, comment
+    suppressions, and a lazy project-level view (config-field tables)."""
+
+    def __init__(self, path: str, relpath: str, source: str, project):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.project = project
+        # line -> list of (frozenset of rule names or {"*"}, reason, raw)
+        self.noqa: Dict[int, List[Tuple[frozenset, str]]] = {}
+        self.noqa_problems: List[Finding] = []
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        from pytorch_cifar_tpu.lint.rules import rule_names
+
+        known = set(rule_names()) | {"*"}
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [
+                (t.start[0], t.string)
+                for t in toks
+                if t.type == tokenize.COMMENT
+            ]
+        except tokenize.TokenError:
+            comments = []
+        for lineno, text in comments:
+            m = _NOQA_RE.search(text)
+            if not m:
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group("rules").split(",") if r.strip()
+            )
+            reason = (m.group("reason") or "").strip()
+            bad = sorted(r for r in rules if r not in known)
+            if not rules or bad:
+                self.noqa_problems.append(
+                    Finding(
+                        SUPPRESSION_RULE, self.relpath, lineno, 0,
+                        "noqa names unknown rule(s) %s — see "
+                        "`tools/lint.py --list-rules`" % (bad or ["<none>"]),
+                    )
+                )
+                continue
+            if not reason:
+                self.noqa_problems.append(
+                    Finding(
+                        SUPPRESSION_RULE, self.relpath, lineno, 0,
+                        "noqa without a reason: write "
+                        "`# graftcheck: noqa[rule] -- why this is safe`",
+                    )
+                )
+                continue
+            self.noqa.setdefault(lineno, []).append((rules, reason))
+
+    def suppression_for(self, finding: Finding):
+        """A noqa applies when it sits on the finding's line or on the
+        line immediately above (comment-above style for statements too
+        long to carry a trailing comment)."""
+        for lineno in (finding.line, finding.line - 1):
+            for rules, reason in self.noqa.get(lineno, ()):
+                if "*" in rules or finding.rule in rules:
+                    return reason
+        return None
+
+
+class _Project:
+    """Lazy cross-file state shared by every ModuleCtx of one run (today:
+    the config-field tables the flag-config-drift rule checks against)."""
+
+    def __init__(self, repo_root: Optional[str]):
+        self.repo_root = repo_root
+        self._config_fields: Optional[Dict[str, set]] = None
+
+    def config_fields(self) -> Dict[str, set]:
+        """{'TrainConfig': {field/property names}, 'ServeConfig': {...}};
+        empty dict when config.py cannot be located (standalone fixture
+        trees: the drift rule then only checks in-module evidence)."""
+        if self._config_fields is not None:
+            return self._config_fields
+        self._config_fields = {}
+        if self.repo_root:
+            cfg = os.path.join(
+                self.repo_root, "pytorch_cifar_tpu", "config.py"
+            )
+            if os.path.isfile(cfg):
+                with open(cfg, encoding="utf-8") as f:
+                    src = f.read()
+                self._config_fields = parse_config_fields(src)
+        return self._config_fields
+
+
+def parse_config_fields(source: str) -> Dict[str, set]:
+    """Extract dataclass field + @property names for the config classes."""
+    out: Dict[str, set] = {}
+    tree = ast.parse(source)
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if node.name not in ("TrainConfig", "ServeConfig"):
+            continue
+        names = set()
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                names.add(stmt.target.id)
+            elif isinstance(stmt, ast.FunctionDef):
+                names.add(stmt.name)
+        out[node.name] = names
+    return out
+
+
+def _find_repo_root(path: str) -> Optional[str]:
+    """Walk up from ``path`` to the directory containing the package."""
+    d = os.path.abspath(path)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    for _ in range(12):
+        if os.path.isfile(
+            os.path.join(d, "pytorch_cifar_tpu", "config.py")
+        ):
+            return d
+        nxt = os.path.dirname(d)
+        if nxt == d:
+            return None
+        d = nxt
+    return None
+
+
+def collect_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".pytest_cache")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+        else:
+            raise FileNotFoundError(p)
+    seen, uniq = set(), []
+    for p in out:
+        ap = os.path.abspath(p)
+        if ap not in seen:
+            seen.add(ap)
+            uniq.append(p)
+    return uniq
+
+
+def _fingerprints(findings: List[Finding], ctx: ModuleCtx) -> None:
+    """Content-keyed fingerprint: hash of (rule, basename, normalized
+    flagged line, k) with k disambiguating identical lines — stable
+    under line moves/renumbering, expired by edits to the flagged code."""
+    counts: Dict[str, int] = {}
+    for f in findings:
+        src = ""
+        if 1 <= f.line <= len(ctx.lines):
+            src = "".join(ctx.lines[f.line - 1].split())
+        base = "%s:%s:%s" % (f.rule, os.path.basename(f.path), src)
+        k = counts.get(base, 0)
+        counts[base] = k + 1
+        f.fingerprint = hashlib.sha1(
+            ("%s:%d" % (base, k)).encode()
+        ).hexdigest()[:16]
+
+
+def lint_file(
+    path: str,
+    rules=None,
+    relpath: Optional[str] = None,
+    project=None,
+) -> List[Finding]:
+    """Run ``rules`` (default: all) over one file; returns findings with
+    fingerprints computed and inline suppressions applied."""
+    from pytorch_cifar_tpu.lint.rules import RULES
+
+    rules = RULES if rules is None else rules
+    relpath = relpath or path
+    if project is None:
+        project = _Project(_find_repo_root(path))
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        ctx = ModuleCtx(path, relpath, source, project)
+    except SyntaxError as e:
+        return [
+            Finding(
+                PARSE_RULE, relpath, e.lineno or 1, e.offset or 0,
+                "file does not parse: %s" % e.msg,
+            )
+        ]
+    findings: List[Finding] = list(ctx.noqa_problems)
+    for rule in rules:
+        for f in rule.check(ctx):
+            f.path = relpath
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    _fingerprints(findings, ctx)
+    for f in findings:
+        if f.rule in (SUPPRESSION_RULE, PARSE_RULE):
+            continue  # meta-findings cannot be noqa'd away
+        reason = ctx.suppression_for(f)
+        if reason is not None:
+            f.suppressed = True
+            f.suppress_reason = reason
+    return findings
+
+
+@dataclasses.dataclass
+class LintRun:
+    findings: List[Finding]
+    files: List[str]  # repo-relative paths of every file linted
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules=None,
+    repo_root: Optional[str] = None,
+) -> LintRun:
+    """Lint every .py under ``paths``. Paths are reported relative to
+    ``repo_root`` (default: auto-detected) when possible. Returns the
+    findings plus the full linted-file list (a clean file produces no
+    findings but still anchors stale-baseline detection)."""
+    files = collect_python_files(paths)
+    root = repo_root or (_find_repo_root(files[0]) if files else None)
+    project = _Project(root)
+    findings: List[Finding] = []
+    rels: List[str] = []
+    for path in files:
+        rel = path
+        if root:
+            try:
+                rel = os.path.relpath(os.path.abspath(path), root)
+            except ValueError:
+                rel = path
+        rels.append(rel)
+        findings.extend(
+            lint_file(path, rules=rules, relpath=rel, project=project)
+        )
+    return LintRun(findings, rels)
+
+
+# -- baseline ----------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (a usage error: CLI exits 2)."""
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        try:
+            obj = json.load(f)
+        except ValueError as e:
+            raise BaselineError("%s: not valid JSON: %s" % (path, e))
+    if (
+        not isinstance(obj, dict)
+        or obj.get("version") != 1
+        or not isinstance(obj.get("findings"), list)
+    ):
+        raise BaselineError(
+            "%s: expected {'version': 1, 'findings': [...]}" % path
+        )
+    for e in obj["findings"]:
+        if not isinstance(e, dict) or "fingerprint" not in e:
+            raise BaselineError(
+                "%s: baseline entries need a 'fingerprint'" % path
+            )
+    return obj["findings"]
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> int:
+    """Write the grandfather file from the run's OPEN findings (already-
+    suppressed ones stay suppressed inline). Returns the entry count."""
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path.replace(os.sep, "/"),
+            "fingerprint": f.fingerprint,
+        }
+        for f in findings
+        if f.status == "open" and f.rule != SUPPRESSION_RULE
+    ]
+    payload = json.dumps({"version": 1, "findings": entries}, indent=2)
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload + "\n")
+    os.replace(tmp, path)
+    return len(entries)
+
+
+def match_baseline(
+    findings: List[Finding],
+    entries: List[dict],
+    linted_files: Optional[Sequence[str]] = None,
+) -> List[dict]:
+    """Mark findings present in the baseline as ``baselined``; return the
+    STALE entries — baseline lines whose file was linted this run but
+    whose finding no longer exists (fixed or edited code), so the
+    baseline can be pruned with ``--write-baseline``. Entries for files
+    outside this run's path set are left alone (a partial run must not
+    declare the rest of the baseline stale)."""
+    by_fp = {f.fingerprint: f for f in findings}
+    if linted_files is None:
+        linted = {f.path.replace(os.sep, "/") for f in findings}
+    else:
+        linted = {p.replace(os.sep, "/") for p in linted_files}
+    stale = []
+    for e in entries:
+        f = by_fp.get(e["fingerprint"])
+        if f is not None:
+            f.baselined = True
+        elif e.get("path") in linted:
+            stale.append(e)
+    return stale
+
+
+# -- reporting ---------------------------------------------------------
+
+
+def summarize(findings: List[Finding]) -> Dict[str, int]:
+    c = {"total": len(findings), "open": 0, "suppressed": 0, "baselined": 0}
+    for f in findings:
+        c[f.status] += 1
+    return c
+
+
+def render_report(
+    findings: List[Finding], stale: Sequence[dict] = (), verbose: bool = False
+) -> str:
+    lines = []
+    for f in findings:
+        if f.status == "open" or verbose:
+            lines.append(f.render())
+    for e in stale:
+        lines.append(
+            "stale baseline entry: %s [%s] %s — fixed or edited; refresh "
+            "with --write-baseline"
+            % (e.get("path", "?"), e.get("rule", "?"), e["fingerprint"])
+        )
+    c = summarize(findings)
+    lines.append(
+        "graftcheck: %d finding(s) — %d open, %d suppressed, %d baselined"
+        % (c["total"], c["open"], c["suppressed"], c["baselined"])
+        + (", %d stale baseline entr%s" % (
+            len(stale), "y" if len(stale) == 1 else "ies"
+        ) if stale else "")
+    )
+    return "\n".join(lines)
+
+
+def json_report(
+    findings: List[Finding], stale: Sequence[dict] = ()
+) -> dict:
+    from pytorch_cifar_tpu.lint.rules import rule_names
+
+    return {
+        "version": 1,
+        "rules": list(rule_names()),
+        "counts": summarize(findings),
+        "findings": [f.to_json() for f in findings],
+        "stale_baseline": list(stale),
+    }

@@ -1,0 +1,1445 @@
+"""graftcheck rules: 8 JAX/concurrency invariants this repo has bled for.
+
+Every rule is grounded in a failure mode from this repo's own history
+(STATIC_ANALYSIS.md has the catalog with one real-world example each).
+Rules are deliberately CONSERVATIVE: a lint that cries wolf gets turned
+off, so each detector only fires on patterns it can resolve statically
+within one module — the fixture tests in tests/test_lint.py pin both the
+positive (fires) and negative (stays quiet) cases for each rule.
+
+Shared analyses:
+
+- :func:`traced_functions` — which function defs end up inside a jax
+  trace (jit/scan/vmap/grad/pallas_call/AOT ``.lower``, decorators,
+  ``make_*_step``/``make_*_epoch`` factory returns, lexical nesting, and
+  one same-module call-graph fixpoint).
+- :func:`qualname` — dotted-name resolution for Name/Attribute chains.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from pytorch_cifar_tpu.lint.engine import Finding, ModuleCtx
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def qualname(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain ('jax.random.fold_in',
+    'self._lock'); None for anything not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_no_nested_funcs(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s subtree but do not descend into nested function
+    definitions (they are analyzed as their own traced/untraced units)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, FuncNode + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+# entry points whose function-valued arguments get traced by jax
+TRACER_CALLS = {
+    "jax.jit", "jit",
+    "jax.vmap", "vmap",
+    "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.map", "lax.map",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+    "pl.pallas_call", "pallas_call",
+}
+TRACER_DECORATORS = {
+    "jax.jit", "jit", "jax.checkpoint", "jax.remat", "jax.vmap", "vmap",
+}
+_FACTORY_RE = re.compile(r"^make_\w*?(step|epoch|fn)\w*$")
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    q = qualname(dec)
+    if q in TRACER_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        fq = qualname(dec.func)
+        if fq in TRACER_DECORATORS:
+            return True
+        # functools.partial(jax.jit, static_argnames=...) styles
+        if fq in ("partial", "functools.partial") and dec.args:
+            return qualname(dec.args[0]) in TRACER_DECORATORS
+    return False
+
+
+def traced_functions(ctx: ModuleCtx) -> Set[ast.AST]:
+    """Function-def nodes whose bodies execute under a jax trace.
+
+    Seeds: tracer decorators; function names (or ``self.X`` aliases of
+    local defs) passed to TRACER_CALLS / ``jax.jit(...).lower``; defs
+    RETURNED from a ``make_*step``/``make_*epoch`` factory (this repo's
+    convention for step closures that the trainer jits later). Closure:
+    defs lexically nested in a traced def, and same-module defs called by
+    name from a traced body (one fixpoint)."""
+    tree = ctx.tree
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    all_defs: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, FuncNode):
+            all_defs.append(node)
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    def enclosing_func(node: ast.AST):
+        p = parents.get(node)
+        while p is not None and not isinstance(p, FuncNode):
+            p = parents.get(p)
+        return p
+
+    def local_def(name: str, at: ast.AST):
+        """The def ``name`` visible from node ``at``: nearest enclosing
+        scope owning one, else a module-level one."""
+        cands = defs_by_name.get(name)
+        if not cands:
+            return None
+        scope = enclosing_func(at)
+        while scope is not None:
+            for d in cands:
+                if enclosing_func(d) is scope:
+                    return d
+            scope = enclosing_func(scope)
+        for d in cands:
+            p = enclosing_func(d)
+            if p is None and not isinstance(parents.get(d), ast.ClassDef):
+                return d
+        return None
+
+    # self.X = <local def> aliases (the engine's self._fwd pattern)
+    self_alias: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in defs_by_name
+        ):
+            for tgt in node.targets:
+                q = qualname(tgt)
+                if q and q.startswith("self."):
+                    d = local_def(node.value.id, node)
+                    if d is not None:
+                        self_alias[q] = d
+
+    traced: Set[ast.AST] = set()
+
+    def seed(fn_expr: ast.AST, at: ast.AST) -> None:
+        if isinstance(fn_expr, ast.Lambda):
+            return  # lambdas have no statements worth walking here
+        q = qualname(fn_expr)
+        if q is None:
+            return
+        if q in self_alias:
+            traced.add(self_alias[q])
+        elif "." not in q:
+            d = local_def(q, at)
+            if d is not None:
+                traced.add(d)
+
+    for node in ast.walk(tree):
+        if isinstance(node, FuncNode):
+            if any(_decorator_traces(d) for d in node.decorator_list):
+                traced.add(node)
+            # `return step` from a make_*_step factory
+            if _FACTORY_RE.match(node.name):
+                for stmt in ast.walk(node):
+                    if isinstance(stmt, ast.Return) and isinstance(
+                        stmt.value, ast.Name
+                    ):
+                        d = local_def(stmt.value.id, stmt)
+                        if d is not None and enclosing_func(d) is node:
+                            traced.add(d)
+        if isinstance(node, ast.Call):
+            q = qualname(node.func)
+            if q in TRACER_CALLS:
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    seed(arg, node)
+
+    # lexical nesting + same-module call graph, to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for d in all_defs:
+            if d in traced:
+                continue
+            p = enclosing_func(d)
+            while p is not None:
+                if p in traced:
+                    traced.add(d)
+                    changed = True
+                    break
+                p = enclosing_func(p)
+        for t in list(traced):
+            for node in walk_no_nested_funcs(t):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name
+                ):
+                    d = local_def(node.func.id, node)
+                    if d is not None and d not in traced:
+                        traced.add(d)
+                        changed = True
+    return traced
+
+
+class Rule:
+    name = "abstract"
+    summary = ""
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleCtx, node: ast.AST, msg: str) -> Finding:
+        return Finding(
+            self.name, ctx.relpath,
+            getattr(node, "lineno", 1), getattr(node, "col_offset", 0), msg,
+        )
+
+
+# ---------------------------------------------------------------------
+# 1. jit-impurity
+# ---------------------------------------------------------------------
+
+_TIME_FNS = {
+    "time", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+    "sleep", "process_time", "time_ns",
+}
+_METRIC_MUTATORS = {"inc", "observe"}
+_OS_SAFE_PREFIXES = ("os.path.", "os.environ.get", "os.getenv", "os.sep")
+
+
+class JitImpurity(Rule):
+    name = "jit-impurity"
+    summary = (
+        "side-effecting call (metrics, logging, time, I/O) inside a "
+        "jax-traced function — it runs ONCE at trace time, then never "
+        "again in the compiled program"
+    )
+
+    def _impure(self, call: ast.Call) -> Optional[str]:
+        q = qualname(call.func)
+        if q is None:
+            # `.set(...)` etc. on computed receivers
+            if isinstance(call.func, ast.Attribute):
+                a = call.func.attr
+                if a in _METRIC_MUTATORS:
+                    return "metric %s()" % a
+                if a == "set" and not self._is_at_set(call.func):
+                    return "gauge/event .set()"
+            return None
+        last = q.rsplit(".", 1)[-1]
+        if q == "print":
+            return "print()"
+        if q == "open":
+            return "open()"
+        if q.startswith("time.") and last in _TIME_FNS:
+            return q + "()"
+        if q.startswith("os.") and not q.startswith(_OS_SAFE_PREFIXES):
+            return q + "()"
+        if q.split(".", 1)[0] in ("log", "logger", "logging") and "." in q:
+            return q + "()"
+        if q in ("trace.span", "trace.instant") or q.endswith(
+            (".trace.span", ".trace.instant")
+        ):
+            return q + "()"
+        if last in _METRIC_MUTATORS and "." in q:
+            return q + "()"
+        if last == "set" and "." in q and not self._is_at_set(call.func):
+            return q + "()"
+        if last == "write" and "." in q:
+            return q + "()"
+        return None
+
+    @staticmethod
+    def _is_at_set(func: ast.Attribute) -> bool:
+        """True for jax's functional update `x.at[i].set(v)`."""
+        v = func.value
+        return (
+            isinstance(v, ast.Subscript)
+            and isinstance(v.value, ast.Attribute)
+            and v.value.attr == "at"
+        )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for fn in traced_functions(ctx):
+            for node in walk_no_nested_funcs(fn):
+                if isinstance(node, ast.Call):
+                    why = self._impure(node)
+                    if why:
+                        out.append(
+                            self.finding(
+                                ctx, node,
+                                "%s inside traced function %r runs once "
+                                "at trace time, not per step — hoist it "
+                                "to the host loop or use jax-native "
+                                "callbacks" % (why, fn.name),
+                            )
+                        )
+        return out
+
+
+# ---------------------------------------------------------------------
+# 2. prng-reuse
+# ---------------------------------------------------------------------
+
+_KEY_PRODUCERS = {
+    "jax.random.PRNGKey", "random.PRNGKey", "jax.random.key",
+    "jax.random.split", "random.split",
+    "jax.random.fold_in", "random.fold_in",
+}
+_NONCONSUMING = {"jax.random.fold_in", "random.fold_in"}
+_KEY_PARAM_RE = re.compile(r"^(key|rng|prng\w*|\w+_key|\w+_rng)$")
+
+
+class PrngReuse(Rule):
+    name = "prng-reuse"
+    summary = (
+        "a PRNG key consumed more than once without split/fold_in — the "
+        "two draws are IDENTICAL (correlated randomness), the classic "
+        "silent jax.random bug"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FuncNode):
+                out.extend(self._check_fn(ctx, node))
+        return out
+
+    def _check_fn(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        # a key-NAMED parameter is only tracked when the function shows
+        # jax.random evidence for it (it appears inside a jax.random.*
+        # call somewhere) — `put(self, key, val)` on a cache class must
+        # not be mistaken for a PRNG key
+        evidenced: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                q = qualname(node.func)
+                if q and (
+                    q.startswith("jax.random.") or q in _KEY_PRODUCERS
+                ):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Load
+                        ):
+                            evidenced.add(sub.id)
+        keys: Set[str] = set()
+        args = fn.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            if _KEY_PARAM_RE.match(a.arg) and a.arg in evidenced:
+                keys.add(a.arg)
+
+        findings: List[Finding] = []
+        flagged: Set[str] = set()
+
+        def producer_targets(stmt) -> List[str]:
+            """Names bound to fresh keys by this statement, or []."""
+            if not isinstance(stmt, ast.Assign) or not isinstance(
+                stmt.value, ast.Call
+            ):
+                return []
+            q = qualname(stmt.value.func)
+            if q not in _KEY_PRODUCERS:
+                return []
+            names = []
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    names.append(tgt.id)
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    names.extend(
+                        e.id for e in tgt.elts if isinstance(e, ast.Name)
+                    )
+            return names
+
+        def uses_in(node: ast.AST) -> Dict[str, List[ast.AST]]:
+            """key-name -> consumption sites inside ``node`` (one
+            statement / expression), honoring fold_in non-consumption.
+            A reference inside a nested def counts once (closure
+            capture)."""
+            sites: Dict[str, List[ast.AST]] = {}
+
+            def visit(n: ast.AST, in_nested: bool) -> None:
+                if isinstance(n, ast.Call):
+                    q = qualname(n.func)
+                    skip_args = q in _NONCONSUMING
+                    for child in ast.iter_child_nodes(n):
+                        if skip_args and child is not n.func:
+                            # fold_in derives; its key operand survives
+                            for sub in ast.walk(child):
+                                if (
+                                    isinstance(sub, ast.Call)
+                                ):
+                                    visit(sub, in_nested)
+                            continue
+                        visit(child, in_nested)
+                    return
+                if isinstance(n, FuncNode + (ast.Lambda,)):
+                    # closure capture counts once — but a name declared
+                    # as a PARAMETER anywhere inside shadows the outer
+                    # key and is that scope's own binding, not a use
+                    shadowed: Set[str] = set()
+                    for sub in ast.walk(n):
+                        if isinstance(sub, FuncNode + (ast.Lambda,)):
+                            sa = sub.args
+                            for a in (
+                                list(sa.posonlyargs)
+                                + list(sa.args)
+                                + list(sa.kwonlyargs)
+                            ):
+                                shadowed.add(a.arg)
+                    seen: Set[str] = set()
+                    for sub in ast.walk(n):
+                        if (
+                            isinstance(sub, ast.Name)
+                            and isinstance(sub.ctx, ast.Load)
+                            and sub.id in keys
+                            and sub.id not in seen
+                            and sub.id not in shadowed
+                        ):
+                            seen.add(sub.id)
+                            sites.setdefault(sub.id, []).append(n)
+                    return
+                if (
+                    isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id in keys
+                ):
+                    sites.setdefault(n.id, []).append(n)
+                for child in ast.iter_child_nodes(n):
+                    visit(child, in_nested)
+
+            visit(node, False)
+            return sites
+
+        def merge_max(a, b):
+            out = dict(a)
+            for k, v in b.items():
+                if len(v) > len(out.get(k, [])):
+                    out[k] = v
+            return out
+
+        def run_block(stmts, counts: Dict[str, List[ast.AST]]):
+            """Sequential count of consumptions per key var; If branches
+            merge by max (exclusive paths). Returns updated counts."""
+            for stmt in stmts:
+                if isinstance(stmt, ast.If):
+                    counts = merge_max(
+                        run_block(stmt.body, dict(counts)),
+                        run_block(stmt.orelse, dict(counts)),
+                    )
+                    # the test itself may consume
+                    counts = note(uses_in(stmt.test), counts, stmt)
+                    continue
+                if isinstance(stmt, (ast.For, ast.While)):
+                    inner = (
+                        [stmt.iter] if isinstance(stmt, ast.For)
+                        else [stmt.test]
+                    )
+                    for e in inner:
+                        counts = note(uses_in(e), counts, stmt)
+                    counts = run_block(
+                        list(stmt.body) + list(stmt.orelse), counts
+                    )
+                    continue
+                if isinstance(stmt, ast.Try):
+                    counts = run_block(stmt.body, counts)
+                    for h in stmt.handlers:
+                        counts = run_block(h.body, counts)
+                    counts = run_block(
+                        list(stmt.orelse) + list(stmt.finalbody), counts
+                    )
+                    continue
+                if isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        counts = note(
+                            uses_in(item.context_expr), counts, stmt
+                        )
+                    counts = run_block(stmt.body, counts)
+                    continue
+                fresh = producer_targets(stmt)
+                # consumptions in this statement's expressions (for an
+                # Assign, the value side — targets are stores)
+                exprs = [stmt]
+                if isinstance(stmt, ast.Assign):
+                    exprs = [stmt.value]
+                elif isinstance(stmt, ast.AugAssign):
+                    exprs = [stmt.value]
+                elif isinstance(stmt, ast.AnnAssign):
+                    exprs = [stmt.value] if stmt.value else []
+                for e in exprs:
+                    counts = note(uses_in(e), counts, stmt)
+                # rebinding resets the trail; fresh producer targets
+                # (re)enter the key set
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    tgts = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for tgt in tgts:
+                        names = (
+                            [tgt]
+                            if isinstance(tgt, ast.Name)
+                            else [
+                                e for e in getattr(tgt, "elts", [])
+                                if isinstance(e, ast.Name)
+                            ]
+                        )
+                        for nm in names:
+                            counts.pop(nm.id, None)
+                            if nm.id in fresh:
+                                keys.add(nm.id)
+                            elif (
+                                isinstance(stmt, ast.Assign)
+                                and isinstance(stmt.value, ast.Name)
+                                and stmt.value.id in keys
+                            ):
+                                keys.add(nm.id)  # alias of a key
+                            else:
+                                keys.discard(nm.id)
+            return counts
+
+        def note(sites, counts, stmt):
+            counts = dict(counts)
+            for name, uses in sites.items():
+                prior = counts.get(name, [])
+                total = prior + uses
+                if len(total) > 1 and name not in flagged:
+                    flagged.add(name)
+                    at = total[1]
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            at if hasattr(at, "lineno") else stmt,
+                            "PRNG key %r is consumed more than once in "
+                            "%r without an intervening split/fold_in — "
+                            "both draws see identical bits" % (
+                                name, fn.name,
+                            ),
+                        )
+                    )
+                counts[name] = total
+            return counts
+
+        run_block(fn.body, {})
+        return findings
+
+
+# ---------------------------------------------------------------------
+# 3. tracer-branch
+# ---------------------------------------------------------------------
+
+_JAX_VALUE_PREFIXES = (
+    "jnp.", "jax.numpy.", "jax.lax.", "lax.", "jax.random.", "jsp.",
+)
+
+
+class TracerBranch(Rule):
+    name = "tracer-branch"
+    summary = (
+        "Python if/while on a traced value inside a jax-traced function "
+        "— raises ConcretizationTypeError or silently specializes at "
+        "trace time; use jnp.where / lax.cond"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for fn in traced_functions(ctx):
+            jax_valued: Set[str] = set()
+            # first pass: names assigned from jnp/lax/random calls (or
+            # expressions containing one / another jax-valued name)
+            for node in walk_no_nested_funcs(fn):
+                if isinstance(node, ast.Assign) and self._jaxish(
+                    node.value, jax_valued
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jax_valued.add(tgt.id)
+                        elif isinstance(tgt, (ast.Tuple, ast.List)):
+                            jax_valued.update(
+                                e.id for e in tgt.elts
+                                if isinstance(e, ast.Name)
+                            )
+            for node in walk_no_nested_funcs(fn):
+                if isinstance(node, (ast.If, ast.While)) and self._jaxish(
+                    node.test, jax_valued, test_position=True
+                ):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(
+                        self.finding(
+                            ctx, node,
+                            "`%s` on a traced value inside traced "
+                            "function %r — the branch is resolved ONCE "
+                            "at trace time; use jnp.where / jax.lax.cond "
+                            "/ lax.while_loop" % (kind, fn.name),
+                        )
+                    )
+        return out
+
+    def _jaxish(
+        self, expr: ast.AST, jax_valued: Set[str], test_position=False
+    ) -> bool:
+        # `x is None` / `x is not None` identity tests are static even
+        # when x later holds a tracer-producing default — never flag
+        if (
+            test_position
+            and isinstance(expr, ast.Compare)
+            and any(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops)
+        ):
+            return False
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                q = qualname(node.func)
+                if q and q.startswith(_JAX_VALUE_PREFIXES):
+                    return True
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in jax_valued
+            ):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------
+# 4. host-sync
+# ---------------------------------------------------------------------
+
+# (path suffix, hot function names): the trainer step loop and the
+# serving dispatch path — the two places a hidden device sync stalls
+# the pipeline for every caller
+_HOT_FUNCTIONS: Sequence[Tuple[str, frozenset]] = (
+    (
+        "train/trainer.py",
+        frozenset({
+            "train_epoch", "eval_epoch", "_train_epoch_compiled",
+            "_dispatch_train_epoch", "_dispatch_eval_epoch",
+            "_timed_batches", "fit", "finish",
+        }),
+    ),
+    (
+        "serve/engine.py",
+        frozenset({"predict", "_run_bucket", "_put_batch"}),
+    ),
+    ("serve/batcher.py", frozenset({"_worker", "_take_batch"})),
+)
+
+_DEVICE_CALL_ATTRS = frozenset({
+    "train_step", "eval_step", "train_epoch_fn", "eval_epoch_fn",
+})
+_HOST_FETCHERS = frozenset({
+    "float", "int", "np.asarray", "np.array", "numpy.asarray",
+    "numpy.array", "np.float32", "np.float64",
+})
+
+
+class HostSync(Rule):
+    name = "host-sync"
+    summary = (
+        ".item()/float()/np.asarray() on a jax array inside the trainer "
+        "step loop or engine dispatch path — a hidden blocking D2H sync "
+        "that stalls dispatch run-ahead (the reference's per-step "
+        ".item() trap)"
+    )
+
+    def _hot_names(self, ctx: ModuleCtx) -> Optional[frozenset]:
+        path = ctx.relpath.replace("\\", "/")
+        for suffix, names in _HOT_FUNCTIONS:
+            if path.endswith(suffix):
+                return names
+        return None
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        hot = self._hot_names(ctx)
+        if hot is None:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FuncNode) and node.name in hot:
+                out.extend(self._check_fn(ctx, node))
+        return out
+
+    @staticmethod
+    def _is_device_call(call: ast.Call) -> bool:
+        f = call.func
+        q = qualname(f)
+        if q:
+            if q == "jax.device_get":
+                return False
+            if q.startswith(("jnp.", "jax.numpy.", "jax.lax.")):
+                return True
+            if q.startswith("self.") and q.rsplit(".", 1)[-1] in (
+                _DEVICE_CALL_ATTRS
+            ):
+                return True
+        # self._compiled[b](...) — AOT executable dispatch
+        if isinstance(f, ast.Subscript):
+            sq = qualname(f.value)
+            if sq and sq.endswith("_compiled"):
+                return True
+        return False
+
+    def _check_fn(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        device: Set[str] = set()
+        host: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                q = qualname(node.value.func)
+                names = []
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.append(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        names.extend(
+                            e.id for e in tgt.elts
+                            if isinstance(e, ast.Name)
+                        )
+                if q == "jax.device_get":
+                    host.update(names)
+                elif self._is_device_call(node.value):
+                    device.update(names)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Name
+            ):
+                if node.value.id in device:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            device.add(tgt.id)
+        device -= host
+
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # any .item() in a hot function is a sync
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+            ):
+                out.append(
+                    self.finding(
+                        ctx, node,
+                        ".item() in hot function %r blocks on the device "
+                        "— accumulate on device and fetch once with "
+                        "jax.device_get" % fn.name,
+                    )
+                )
+                continue
+            q = qualname(node.func)
+            if q not in _HOST_FETCHERS or not node.args:
+                continue
+            arg = node.args[0]
+            sync = False
+            if isinstance(arg, ast.Name) and arg.id in device:
+                sync = True
+            elif isinstance(arg, ast.Call) and self._is_device_call(arg):
+                sync = True
+            elif isinstance(arg, ast.Subscript):
+                base = arg.value
+                if isinstance(base, ast.Name) and base.id in device:
+                    sync = True
+            if sync:
+                out.append(
+                    self.finding(
+                        ctx, node,
+                        "%s() on a device value in hot function %r is a "
+                        "hidden blocking transfer — route it through one "
+                        "explicit jax.device_get at the sync point"
+                        % (q, fn.name),
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------
+# 5. donation-misuse
+# ---------------------------------------------------------------------
+
+
+class DonationMisuse(Rule):
+    name = "donation-misuse"
+    summary = (
+        "an argument donated via donate_argnums is read again after the "
+        "jitted call — the buffer was handed to XLA and may already hold "
+        "the output (garbage reads, or the donate-same-buffer abort)"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FuncNode):
+                out.extend(self._check_fn(ctx, node))
+        return out
+
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> Optional[List[int]]:
+        if qualname(call.func) not in ("jax.jit", "jit"):
+            return None
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                pos = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int
+                    ):
+                        pos.append(e.value)
+                return pos
+        return None
+
+    def _check_fn(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        donating: Dict[str, List[int]] = {}
+        out: List[Finding] = []
+        seen_sites: Set[Tuple[int, int, str]] = set()
+
+        def scan_block(stmts):
+            for i, stmt in enumerate(stmts):
+                # record `g = jax.jit(f, donate_argnums=...)`
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call
+                ):
+                    pos = self._donated_positions(stmt.value)
+                    if pos is not None:
+                        for tgt in stmt.targets:
+                            if isinstance(tgt, ast.Name):
+                                donating[tgt.id] = pos
+                # find calls of a donating function in this statement
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if not isinstance(node.func, ast.Name):
+                        continue
+                    pos = donating.get(node.func.id)
+                    if pos is None:
+                        continue
+                    donated_names = {
+                        node.args[p].id
+                        for p in pos
+                        if p < len(node.args)
+                        and isinstance(node.args[p], ast.Name)
+                    }
+                    if not donated_names:
+                        continue
+                    # names STORED anywhere inside the same statement
+                    # subtree are rebound by the call's own result (the
+                    # `state, m = step(state, ...)` idiom — including
+                    # inside a for-loop statement) and are safe to read
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Store
+                        ):
+                            donated_names.discard(sub.id)
+                    for f in self._reads_after(
+                        ctx, stmts[i + 1:], donated_names, node.func.id
+                    ):
+                        site = (f.line, f.col, f.message)
+                        if site not in seen_sites:
+                            # the nested-block rescans below revisit the
+                            # same call with a shorter tail — dedupe
+                            seen_sites.add(site)
+                            out.append(f)
+                # recurse into nested blocks for the donating-call scan
+                for attr in ("body", "orelse", "finalbody"):
+                    inner = getattr(stmt, attr, None)
+                    if inner:
+                        scan_block(inner)
+
+        scan_block(fn.body)
+        return out
+
+    def _reads_after(
+        self, ctx, later_stmts, names: Set[str], fname: str
+    ) -> List[Finding]:
+        out = []
+        live = set(names)
+        for stmt in later_stmts:
+            if not live:
+                break
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and node.id in live:
+                    if isinstance(node.ctx, ast.Load):
+                        out.append(
+                            self.finding(
+                                ctx, node,
+                                "%r was donated to %s() above and may "
+                                "already be overwritten — reading it "
+                                "here is undefined; keep a copy or "
+                                "don't donate" % (node.id, fname),
+                            )
+                        )
+                        live.discard(node.id)
+                    else:
+                        live.discard(node.id)  # rebound: safe again
+        return out
+
+
+# ---------------------------------------------------------------------
+# 6. unlocked-shared-mutation
+# ---------------------------------------------------------------------
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+_EVENT_CTORS = {"threading.Event", "Event"}
+_CONTAINER_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "clear", "add", "discard", "update", "setdefault",
+})
+
+
+class UnlockedSharedMutation(Rule):
+    name = "unlocked-shared-mutation"
+    summary = (
+        "attribute of a thread-shared class mutated outside its lock — "
+        "shared = mutated by the background thread, guarded elsewhere, "
+        "or a Thread handle; `_locked`-suffixed methods assert the "
+        "caller holds the lock"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    def _check_class(self, ctx: ModuleCtx, cls: ast.ClassDef):
+        methods = {
+            n.name: n for n in cls.body if isinstance(n, FuncNode)
+        }
+        lock_attrs: Set[str] = set()
+        event_attrs: Set[str] = set()
+        thread_attrs: Set[str] = set()
+        spawns_thread = False
+        thread_entries: List[ast.AST] = []  # defs run by the thread
+
+        local_defs: Dict[Tuple[str, str], ast.AST] = {}
+        for mname, m in methods.items():
+            for node in ast.walk(m):
+                if isinstance(node, FuncNode) and node is not m:
+                    local_defs[(mname, node.name)] = node
+
+        for mname, m in methods.items():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    q = qualname(node.value.func)
+                    attrs = [
+                        qualname(t)
+                        for t in node.targets
+                        if qualname(t) and qualname(t).startswith("self.")
+                    ]
+                    names = [a.split(".", 1)[1] for a in attrs]
+                    if q in _LOCK_CTORS:
+                        lock_attrs.update(names)
+                    elif q in _EVENT_CTORS:
+                        event_attrs.update(names)
+                    elif q in ("threading.Thread", "Thread"):
+                        thread_attrs.update(names)
+                if isinstance(node, ast.Call) and qualname(node.func) in (
+                    "threading.Thread", "Thread",
+                ):
+                    spawns_thread = True
+                    for kw in node.keywords:
+                        if kw.arg != "target":
+                            continue
+                        tq = qualname(kw.value)
+                        if tq and tq.startswith("self."):
+                            entry = methods.get(tq.split(".", 1)[1])
+                            if entry is not None:
+                                thread_entries.append(entry)
+                        elif isinstance(kw.value, ast.Name):
+                            d = local_defs.get((mname, kw.value.id))
+                            if d is not None:
+                                thread_entries.append(d)
+        if not spawns_thread and not lock_attrs:
+            return []
+
+        # close thread-reachable set over self.method() calls
+        reachable = list(thread_entries)
+        seen = set(id(n) for n in reachable)
+        i = 0
+        while i < len(reachable):
+            node = reachable[i]
+            i += 1
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    q = qualname(sub.func)
+                    if q and q.startswith("self."):
+                        m = methods.get(q.split(".", 1)[1])
+                        if m is not None and id(m) not in seen:
+                            seen.add(id(m))
+                            reachable.append(m)
+
+        def mutations(node, under_lock: bool, out_list):
+            """Collect (attr, node) mutations of self attrs in ``node``,
+            honoring `with self.<lock>:` scoping."""
+            if isinstance(node, ast.With):
+                locked = under_lock or any(
+                    (q := qualname(item.context_expr)) is not None
+                    and q.startswith("self.")
+                    and q.split(".", 1)[1] in lock_attrs
+                    or (
+                        isinstance(item.context_expr, ast.Call)
+                        and (cq := qualname(item.context_expr.func))
+                        is not None
+                        and cq.startswith("self.")
+                        and cq.split(".", 2)[1] in lock_attrs
+                    )
+                    for item in node.items
+                )
+                for child in node.body:
+                    mutations(child, locked, out_list)
+                for item in node.items:
+                    mutations(item.context_expr, under_lock, out_list)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in tgts:
+                    base = tgt
+                    if isinstance(base, (ast.Tuple, ast.List)):
+                        for e in base.elts:
+                            q = qualname(e)
+                            if q and q.startswith("self."):
+                                out_list.append(
+                                    (q.split(".", 1)[1], e, under_lock)
+                                )
+                        continue
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                    q = qualname(base)
+                    if q and q.startswith("self."):
+                        out_list.append(
+                            (q.split(".", 1)[1], tgt, under_lock)
+                        )
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr in _CONTAINER_MUTATORS:
+                    q = qualname(node.func.value)
+                    if q and q.startswith("self."):
+                        out_list.append(
+                            (q.split(".", 1)[1], node, under_lock)
+                        )
+            for child in ast.iter_child_nodes(node):
+                mutations(child, under_lock, out_list)
+
+        # shared set: mutated by thread-reachable code, accessed under a
+        # lock anywhere, or a Thread handle
+        shared: Set[str] = set(thread_attrs)
+        for entry in reachable:
+            muts: List = []
+            mutations(entry, False, muts)
+            shared.update(a for a, _, _ in muts)
+        for mname, m in methods.items():
+            muts = []
+            mutations(m, False, muts)
+            shared.update(a for a, _, locked in muts if locked)
+        shared -= lock_attrs
+        shared -= event_attrs
+        if not shared:
+            return []
+
+        findings = []
+        for mname, m in methods.items():
+            if mname == "__init__" or mname.endswith("_locked"):
+                # __init__ runs before the object is published;
+                # *_locked methods document "caller holds the lock"
+                continue
+            muts = []
+            mutations(m, False, muts)
+            flagged_nodes = set()
+            for attr, node, locked in muts:
+                if locked or attr not in shared:
+                    continue
+                if id(node) in flagged_nodes:
+                    continue
+                flagged_nodes.add(id(node))
+                findings.append(
+                    self.finding(
+                        ctx, node,
+                        "%s.%s mutates thread-shared attribute %r "
+                        "outside a lock — wrap it in `with self.<lock>` "
+                        "(or move it to a *_locked method whose callers "
+                        "hold the lock)" % (cls.name, mname, attr),
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------
+# 7. compat-bypass
+# ---------------------------------------------------------------------
+
+# module suffix -> the APIs it is the sanctioned shim for
+_SHIM_MODULES = {
+    "parallel/dp.py": {"shard_map"},
+    "parallel/mesh.py": {"is_initialized"},
+    "pytorch_cifar_tpu/__init__.py": {"xla_flags"},
+    "tests/conftest.py": {"xla_flags"},  # the probe-gated bootstrap
+}
+
+
+class CompatBypass(Rule):
+    name = "compat-bypass"
+    summary = (
+        "direct use of a version-gated API (jax.shard_map, "
+        "jax.distributed.is_initialized, raw XLA_FLAGS writes) instead "
+        "of the probing shims — on the wrong jaxlib these abort the "
+        "process or AttributeError every entry point"
+    )
+
+    def _allowed(self, ctx: ModuleCtx, what: str) -> bool:
+        path = ctx.relpath.replace("\\", "/")
+        for suffix, grants in _SHIM_MODULES.items():
+            if path.endswith(suffix) and what in grants:
+                return True
+        return False
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                names = {a.name for a in node.names}
+                if (
+                    mod in ("jax.experimental.shard_map",)
+                    or (mod == "jax" and "shard_map" in names)
+                ) and not self._allowed(ctx, "shard_map"):
+                    out.append(
+                        self.finding(
+                            ctx, node,
+                            "import shard_map from parallel/dp.py (the "
+                            "check_vma/check_rep version shim), never "
+                            "from jax directly",
+                        )
+                    )
+            if isinstance(node, ast.Attribute):
+                q = qualname(node)
+                if q == "jax.shard_map" and not self._allowed(
+                    ctx, "shard_map"
+                ):
+                    out.append(
+                        self.finding(
+                            ctx, node,
+                            "jax.shard_map does not exist on jax < 0.5 — "
+                            "use parallel.dp.shard_map (the version shim)",
+                        )
+                    )
+                if q == "jax.distributed.is_initialized" and not (
+                    self._allowed(ctx, "is_initialized")
+                ):
+                    out.append(
+                        self.finding(
+                            ctx, node,
+                            "jax.distributed.is_initialized landed after "
+                            "jaxlib 0.4.x — use parallel.mesh."
+                            "_distributed_is_initialized (the probing "
+                            "shim)",
+                        )
+                    )
+            # os.environ["XLA_FLAGS"] = ... (store / setdefault)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if self._is_environ_xla_flags(tgt) and not (
+                        self._allowed(ctx, "xla_flags")
+                    ):
+                        out.append(
+                            self.finding(
+                                ctx, tgt,
+                                "raw os.environ['XLA_FLAGS'] write: an "
+                                "UNKNOWN flag hard-aborts every process "
+                                "(parse_flags_from_env.cc) — gate new "
+                                "flags behind pytorch_cifar_tpu."
+                                "_xla_supports_flag / use "
+                                "xla_collective_timeout_flags()",
+                            )
+                        )
+            if isinstance(node, ast.Call):
+                q = qualname(node.func)
+                if (
+                    q == "os.environ.setdefault"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "XLA_FLAGS"
+                    and not self._allowed(ctx, "xla_flags")
+                ):
+                    out.append(
+                        self.finding(
+                            ctx, node,
+                            "raw os.environ XLA_FLAGS mutation — probe "
+                            "flag support first (compat shims in "
+                            "pytorch_cifar_tpu/__init__.py)",
+                        )
+                    )
+        return out
+
+    @staticmethod
+    def _is_environ_xla_flags(tgt: ast.AST) -> bool:
+        return (
+            isinstance(tgt, ast.Subscript)
+            and qualname(tgt.value) == "os.environ"
+            and isinstance(tgt.slice, ast.Constant)
+            and tgt.slice.value == "XLA_FLAGS"
+        )
+
+
+# ---------------------------------------------------------------------
+# 8. flag-config-drift
+# ---------------------------------------------------------------------
+
+_CFG_BUILDERS = {
+    "parse_config": "TrainConfig",
+    "parse_serve_config": "ServeConfig",
+    "TrainConfig": "TrainConfig",
+    "ServeConfig": "ServeConfig",
+}
+# dataclass machinery + stdlib attrs that are always legal
+_CFG_ALWAYS_OK = frozenset({"__class__", "__dict__", "__dataclass_fields__"})
+
+
+class FlagConfigDrift(Rule):
+    name = "flag-config-drift"
+    summary = (
+        "TrainConfig/ServeConfig attribute access (or constructor kwarg) "
+        "that matches no declared field — config/CLI drift: argparse "
+        "flags are GENERATED from the dataclass fields, so a phantom "
+        "attribute silently has no flag (and vice versa)"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        fields = ctx.project.config_fields()
+        if not fields:
+            fields = parse_own_config(ctx)
+        if not fields:
+            return []
+        out = []
+        out.extend(self._check_structural(ctx))
+        tracked = self._tracked_exprs(ctx)
+        if not tracked:
+            return out
+        union_ok = set().union(*fields.values()) | _CFG_ALWAYS_OK
+        for node in ast.walk(ctx.tree):
+            # constructor kwargs: TrainConfig(bogus=1)
+            if isinstance(node, ast.Call):
+                q = qualname(node.func)
+                cls = _CFG_BUILDERS.get((q or "").rsplit(".", 1)[-1])
+                if cls in ("TrainConfig", "ServeConfig") and (
+                    q or ""
+                ).rsplit(".", 1)[-1] in ("TrainConfig", "ServeConfig"):
+                    ok = fields.get(cls, union_ok)
+                    for kw in node.keywords:
+                        if kw.arg is not None and kw.arg not in ok:
+                            out.append(
+                                self.finding(
+                                    ctx, node,
+                                    "%s(%s=...) matches no declared "
+                                    "field — config/flag drift"
+                                    % (cls, kw.arg),
+                                )
+                            )
+            if not isinstance(node, ast.Attribute):
+                continue
+            base_q = qualname(node.value)
+            if base_q is None:
+                continue
+            cls = tracked.get(base_q)
+            if cls is None:
+                continue
+            ok = fields.get(cls) or union_ok
+            ok = ok | _CFG_ALWAYS_OK
+            if node.attr not in ok and not node.attr.startswith("__"):
+                out.append(
+                    self.finding(
+                        ctx, node,
+                        "%s has no field %r (checked against the "
+                        "dataclass in config.py, which GENERATES the "
+                        "CLI flags) — config/flag drift"
+                        % (cls, node.attr),
+                    )
+                )
+        return out
+
+    def _tracked_exprs(self, ctx: ModuleCtx) -> Dict[str, str]:
+        """Expression qualname -> config class, for names/attrs known to
+        hold a TrainConfig/ServeConfig: ``cfg = parse_config()``,
+        annotated params ``config: TrainConfig``, ``self.config = cfg``,
+        and simple aliases of any of those."""
+        tracked: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FuncNode):
+                for a in node.args.args + node.args.kwonlyargs:
+                    ann = a.annotation
+                    q = qualname(ann) if ann is not None else None
+                    if q and q.rsplit(".", 1)[-1] in (
+                        "TrainConfig", "ServeConfig",
+                    ):
+                        tracked[a.arg] = q.rsplit(".", 1)[-1]
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                cls = None
+                if isinstance(node.value, ast.Call):
+                    q = qualname(node.value.func)
+                    cls = _CFG_BUILDERS.get((q or "").rsplit(".", 1)[-1])
+                else:
+                    vq = qualname(node.value)
+                    if vq is not None:
+                        cls = tracked.get(vq)
+                if cls is None:
+                    continue
+                for tgt in node.targets:
+                    tq = qualname(tgt)
+                    if tq is not None and tracked.get(tq) != cls:
+                        tracked[tq] = cls
+                        changed = True
+        return tracked
+
+    def _check_structural(self, ctx: ModuleCtx) -> List[Finding]:
+        """Inside config.py itself: parse_config/parse_serve_config must
+        still route through _add_args (the field->flag generator — a
+        hand-rolled parser is how drift starts), and field-name string
+        literals special-cased in _add_args must exist as fields."""
+        path = ctx.relpath.replace("\\", "/")
+        if not path.endswith("config.py"):
+            return []
+        fields = parse_config_fields_from_tree(ctx.tree)
+        if not fields:
+            return []
+        union = set().union(*fields.values())
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FuncNode) and node.name in (
+                "parse_config", "parse_serve_config",
+            ):
+                calls = {
+                    qualname(c.func)
+                    for c in ast.walk(node)
+                    if isinstance(c, ast.Call)
+                }
+                if "_add_args" not in calls:
+                    out.append(
+                        self.finding(
+                            ctx, node,
+                            "%s() no longer routes through _add_args — "
+                            "flags must stay GENERATED from the "
+                            "dataclass fields or they drift" % node.name,
+                        )
+                    )
+            if isinstance(node, FuncNode) and node.name == "_add_args":
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Compare):
+                        continue
+                    # only field-NAME comparisons (`f.name == ...` /
+                    # `f.name in (...)`); `f.type == "bool"` etc. compare
+                    # other metadata and must not be cross-checked
+                    lq = qualname(sub.left)
+                    if not (lq and lq.endswith(".name")):
+                        continue
+                    names = [
+                        c.value
+                        for c in ast.walk(sub)
+                        if isinstance(c, ast.Constant)
+                        and isinstance(c.value, str)
+                    ]
+                    for nm in names:
+                        if nm.isidentifier() and nm not in union:
+                            out.append(
+                                self.finding(
+                                    ctx, sub,
+                                    "_add_args special-cases field %r "
+                                    "which no config class declares — "
+                                    "stale after a rename?" % nm,
+                                )
+                            )
+        return out
+
+
+def parse_config_fields_from_tree(tree: ast.Module) -> Dict[str, set]:
+    out: Dict[str, set] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name in (
+            "TrainConfig", "ServeConfig",
+        ):
+            names = set()
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    names.add(stmt.target.id)
+                elif isinstance(stmt, ast.FunctionDef):
+                    names.add(stmt.name)
+            out[node.name] = names
+    return out
+
+
+def parse_own_config(ctx: ModuleCtx) -> Dict[str, set]:
+    """Fixture fallback: a standalone file defining the config classes."""
+    return parse_config_fields_from_tree(ctx.tree)
+
+
+RULES = (
+    JitImpurity(),
+    PrngReuse(),
+    TracerBranch(),
+    HostSync(),
+    DonationMisuse(),
+    UnlockedSharedMutation(),
+    CompatBypass(),
+    FlagConfigDrift(),
+)
+
+
+def rule_names() -> Tuple[str, ...]:
+    return tuple(r.name for r in RULES)
+
+
+def rules_by_name(names: Sequence[str]):
+    by = {r.name: r for r in RULES}
+    missing = [n for n in names if n not in by]
+    if missing:
+        raise KeyError(missing)
+    return tuple(by[n] for n in names)

@@ -870,12 +870,14 @@ class Trainer:
                     keep_last_n=self.config.keep_last_n,
                     registry=self.obs,
                 )
+                # graftcheck: noqa[unlocked-shared-mutation] -- single writer by construction: at most one ckpt-writer thread exists (is_alive gate in _kick_async_save) and readers resynchronize via join() in flush_checkpoints
                 self._written_epoch = snap[1]
             except Exception:
                 log.exception(
                     "async checkpoint write failed (epoch %d)", snap[1]
                 )
 
+        # graftcheck: noqa[unlocked-shared-mutation] -- only the training thread ever assigns the writer handle, and it first proves the previous writer dead via is_alive(); the hot loop stays lock-free by design
         self._save_thread = threading.Thread(
             target=work, name="ckpt-writer", daemon=True
         )
@@ -895,6 +897,7 @@ class Trainer:
                 keep_last_n=self.config.keep_last_n,
                 registry=self.obs,
             )
+            # graftcheck: noqa[unlocked-shared-mutation] -- runs strictly after t.join() above, so the writer thread is finished; happens-before makes this store race-free
             self._written_epoch = snap[1]
 
     def fit(self) -> float:

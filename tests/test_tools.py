@@ -268,6 +268,183 @@ def test_export_warns_on_missing_sidecar(tmp_path):
     assert "warning: cannot read checkpoint sidecar" not in r2.stderr
 
 
+def test_lint_cli_exit_codes(tmp_path):
+    """tools/lint.py driver contract: 0 clean / 1 findings / 2 usage
+    error (STATIC_ANALYSIS.md)."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("import jax\n\ndef f(key):\n"
+                     "    return jax.random.bernoulli(key)\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    lint = os.path.join(REPO, "tools", "lint.py")
+    r = _run_tool([lint, "--no-baseline", str(clean)])
+    assert "0 open" in r.stdout
+    r = _run_tool([lint, "--no-baseline", str(dirty)],
+                  expected_returncode=1)
+    assert "[prng-reuse]" in r.stdout
+    # usage errors: unknown rule, missing path
+    _run_tool([lint, "--rules", "no-such-rule", str(clean)],
+              expected_returncode=2)
+    _run_tool([lint, "--no-baseline", str(tmp_path / "absent.py")],
+              expected_returncode=2)
+    # a file that does not parse is a FINDING (exit 1), not a usage error
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    r = _run_tool([lint, "--no-baseline", str(bad)],
+                  expected_returncode=1)
+    assert "[parse-error]" in r.stdout
+
+
+def test_lint_cli_json_schema(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    lint = os.path.join(REPO, "tools", "lint.py")
+    r = _run_tool([lint, "--no-baseline", "--json", str(dirty)],
+                  expected_returncode=1)
+    d = json.loads(r.stdout)
+    assert d["version"] == 1
+    assert d["counts"]["open"] == 1
+    assert len(d["rules"]) >= 8
+    (f,) = d["findings"]
+    assert f["rule"] == "prng-reuse"
+    assert f["status"] == "open"
+    assert f["path"].endswith("dirty.py") and f["line"] > 0
+    assert len(f["fingerprint"]) == 16
+
+
+def test_lint_cli_baseline_add_and_expire(tmp_path):
+    """--write-baseline grandfathers open findings (next run exits 0,
+    reported as baselined); fixing the code turns the entry STALE and
+    the CLI says so."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    bl = tmp_path / "baseline.json"
+    lint = os.path.join(REPO, "tools", "lint.py")
+    r = _run_tool(
+        [lint, "--baseline", str(bl), "--write-baseline", str(mod)]
+    )
+    assert "wrote 1 baseline entry" in r.stdout
+    r = _run_tool([lint, "--baseline", str(bl), str(mod)])
+    assert "1 baselined" in r.stdout and "0 open" in r.stdout
+    # malformed baseline file: usage error
+    (tmp_path / "broken.json").write_text("{nope")
+    _run_tool(
+        [lint, "--baseline", str(tmp_path / "broken.json"), str(mod)],
+        expected_returncode=2,
+    )
+    # bug fixed -> stale entry reported, still exit 0
+    mod.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    ka, kb = jax.random.split(key)\n"
+        "    return jax.random.bernoulli(ka), jax.random.bernoulli(kb)\n"
+    )
+    r = _run_tool([lint, "--baseline", str(bl), str(mod)])
+    assert "stale baseline entry" in r.stdout
+
+
+def test_lint_cli_noqa_without_reason_rejected(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)  "
+        "# graftcheck: noqa[prng-reuse]\n"
+        "    return a, b\n"
+    )
+    lint = os.path.join(REPO, "tools", "lint.py")
+    r = _run_tool([lint, "--no-baseline", str(mod)],
+                  expected_returncode=1)
+    assert "[suppression]" in r.stdout and "without a reason" in r.stdout
+    # with a reason: suppressed, clean exit
+    mod.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)  "
+        "# graftcheck: noqa[prng-reuse] -- fixture reuse on purpose\n"
+        "    return a, b\n"
+    )
+    r = _run_tool([lint, "--no-baseline", str(mod)])
+    assert "1 suppressed" in r.stdout
+
+
+def test_lint_cli_changed_mode(tmp_path):
+    """--changed lints only the files `git status` reports — the
+    pre-commit inner loop (fast even in a huge tree)."""
+    import subprocess as sp
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    # the engine walks up for pytorch_cifar_tpu/config.py; a bare tree
+    # without one is fine (drift rule just has no table to check)
+    env = dict(os.environ)
+    env.update(
+        GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+        GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+    )
+
+    def git(*args):
+        sp.run(["git", *args], cwd=repo, check=True, env=env,
+               capture_output=True)
+
+    git("init", "-q")
+    committed = repo / "committed.py"
+    committed.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    git("add", "committed.py")
+    git("commit", "-qm", "seed")
+    dirty = repo / "dirty.py"
+    dirty.write_text(
+        "import jax\n\ndef g(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    # run the CLI from a copy inside the tmp repo so its REPO/git root is
+    # the fixture repo, not this checkout
+    tools = repo / "tools"
+    tools.mkdir()
+    with open(os.path.join(REPO, "tools", "lint.py")) as f:
+        src = f.read()
+    (tools / "lint.py").write_text(src)
+    pkg = repo / "pytorch_cifar_tpu"
+    import shutil
+
+    shutil.copytree(
+        os.path.join(REPO, "pytorch_cifar_tpu", "lint"), pkg / "lint"
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text("")
+    r = sp.run(
+        [sys.executable, str(tools / "lint.py"), "--changed",
+         "--no-baseline"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=120,
+    )
+    # only the uncommitted file is linted: its finding appears, the
+    # committed twin's does not
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "dirty.py" in r.stdout
+    assert "committed.py" not in r.stdout
+
+
 def test_zoo_bench_smoke(tmp_path):
     """zoo_bench end-to-end on CPU: clamps, benches, writes the JSON
     artifact this repo's family table is built from."""

@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+"""graftcheck CLI: run the JAX-aware static-analysis pass (lint/).
+
+    python tools/lint.py                        # the default tree
+    python tools/lint.py pytorch_cifar_tpu/serve
+    python tools/lint.py --changed              # only `git diff` files
+    python tools/lint.py --json                 # machine-readable
+    python tools/lint.py --list-rules
+    python tools/lint.py --rules prng-reuse,jit-impurity somefile.py
+    python tools/lint.py --write-baseline       # grandfather what's open
+
+Exit codes: 0 clean (suppressed/baselined findings do not fail the run),
+1 unsuppressed findings (including malformed noqa comments and files
+that do not parse), 2 usage error (unknown rule, missing path, malformed
+baseline, --changed outside a git checkout).
+
+STATIC_ANALYSIS.md documents the rule catalog and the suppression policy
+(``# graftcheck: noqa[rule] -- reason``; the reason is mandatory).
+
+Importable without jax: the lint package is pure stdlib, so this runs in
+any Python — including pre-commit hooks on machines with no accelerator
+stack installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytorch_cifar_tpu.lint import (  # noqa: E402
+    BaselineError,
+    lint_paths,
+    load_baseline,
+    match_baseline,
+    write_baseline,
+)
+from pytorch_cifar_tpu.lint import engine as _engine  # noqa: E402
+from pytorch_cifar_tpu.lint.rules import (  # noqa: E402
+    RULES,
+    rules_by_name,
+)
+
+# the default tree: the package plus every entry point and tool that
+# ships with it (tests/ lint on demand or via --changed)
+DEFAULT_PATHS = (
+    "pytorch_cifar_tpu",
+    "tools",
+    "train.py",
+    "serve.py",
+    "bench.py",
+)
+DEFAULT_BASELINE = os.path.join("tools", "graftcheck_baseline.json")
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
+
+
+def changed_files() -> list:
+    """Modified + untracked .py files from git — the pre-commit inner
+    loop (lint only what this change touches)."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--no-renames"],
+            capture_output=True, text=True, cwd=REPO, check=True,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        raise SystemExit(
+            "graftcheck: --changed needs a git checkout (%s)" % e
+        )
+    paths = []
+    for line in out.splitlines():
+        if len(line) < 4 or line[:2] == "D " or line[1] == "D":
+            continue
+        p = line[3:].strip()
+        if p.endswith(".py") and os.path.isfile(os.path.join(REPO, p)):
+            paths.append(os.path.join(REPO, p))
+    return paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="graftcheck: JAX-aware static analysis "
+        "(STATIC_ANALYSIS.md)"
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: the "
+                    "package, tools/ and the entry points)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files modified per `git status`")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: %s if present)"
+                    % DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather the run's open findings into the "
+                    "baseline file and exit 0")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print suppressed/baselined findings")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage errors already; normalize the rest
+        return EXIT_USAGE if e.code not in (0,) else 0
+
+    if args.list_rules:
+        for r in RULES:
+            print("%-26s %s" % (r.name, r.summary))
+        return EXIT_CLEAN
+
+    rules = None
+    if args.rules:
+        try:
+            rules = rules_by_name(
+                [r.strip() for r in args.rules.split(",") if r.strip()]
+            )
+        except KeyError as e:
+            print(
+                "graftcheck: unknown rule(s) %s — see --list-rules"
+                % e.args[0],
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
+
+    if args.changed:
+        paths = changed_files()
+        if not paths:
+            print("graftcheck: no changed .py files")
+            return EXIT_CLEAN
+    elif args.paths:
+        paths = args.paths
+    else:
+        paths = [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+
+    try:
+        run = lint_paths(paths, rules=rules, repo_root=REPO)
+    except FileNotFoundError as e:
+        print("graftcheck: no such path: %s" % e, file=sys.stderr)
+        return EXIT_USAGE
+
+    baseline_path = args.baseline or os.path.join(REPO, DEFAULT_BASELINE)
+    stale = []
+    if args.write_baseline:
+        n = write_baseline(baseline_path, run.findings)
+        print(
+            "graftcheck: wrote %d baseline entr%s to %s"
+            % (n, "y" if n == 1 else "ies",
+               os.path.relpath(baseline_path, REPO))
+        )
+        return EXIT_CLEAN
+    if not args.no_baseline and os.path.isfile(baseline_path):
+        try:
+            entries = load_baseline(baseline_path)
+        except BaselineError as e:
+            print("graftcheck: %s" % e, file=sys.stderr)
+            return EXIT_USAGE
+        stale = match_baseline(run.findings, entries, run.files)
+
+    if args.json:
+        import json
+
+        print(json.dumps(_engine.json_report(run.findings, stale)))
+    else:
+        print(_engine.render_report(run.findings, stale,
+                                    verbose=args.verbose))
+    open_count = sum(1 for f in run.findings if f.status == "open")
+    return EXIT_FINDINGS if open_count else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

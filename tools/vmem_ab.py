@@ -81,6 +81,7 @@ def main() -> int:
             _, state, step, best = arm
             t0 = time.perf_counter()
             for _ in range(args.steps):
+                # graftcheck: noqa[prng-reuse] -- deliberate: the step folds state.step into rng (distinct bits per call), and every A/B arm must see the SAME stream for a fair comparison
                 state, m = step(state, (x, y), rng)
             float(m["loss_sum"])
             dt = (time.perf_counter() - t0) / args.steps
