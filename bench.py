@@ -343,10 +343,20 @@ def run_epoch(model: str, batch: int, compute_dtype, repeats: int = 1):
     return best, obs
 
 
-def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
+def run_pipeline(batch: int, steps: int, host_augment: bool = True):
     """Host input-pipeline throughput: native gather + host augmentation +
     sharded device_put, no model step (SURVEY.md §7 hard part #2 — the
     pipeline must outrun the chips; compare against the model numbers).
+
+    Measures BOTH loader modes — the async background prefetcher (the
+    production default) and the inline `--async_input off` path — so the
+    async-vs-sync delta lands in the single-JSON-line contract next to the
+    wait fractions. The headline ``value`` is the async number (what
+    training actually runs); the sync figure and the ratio ride along.
+    ``input_wait_frac`` here is time the CONSUMER spent blocked waiting
+    for the next batch as a fraction of the drain wall-clock — the same
+    wait-side definition the trainer's ``train.input_wait_ms`` histogram
+    uses (OBSERVABILITY.md). Returns (async img/s, extra dict).
     """
     from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
     from pytorch_cifar_tpu.data.pipeline import Dataloader
@@ -356,36 +366,61 @@ def run_pipeline(batch: int, steps: int, host_augment: bool = True) -> float:
     if batch > n:
         raise SystemExit(f"--batch {batch} exceeds the {n}-image bench set")
     tr_x, tr_y, _, _ = synthetic_cifar10(n_train=n, n_test=8)
-    # same transfer path as the trainer: NamedSharding over the device mesh
-    # (trainer.py builds the loader with exactly this sharding)
-    loader = Dataloader(
-        tr_x,
-        tr_y,
-        batch_size=batch,
-        seed=0,
-        host_augment=host_augment,
-        sharding=batch_sharding(make_mesh()),
-    )
+    sharding = batch_sharding(make_mesh())
 
-    def drain(epoch):
-        # full epochs only: breaking mid-epoch would abandon staged
-        # prefetch batches whose gather/augment/put cost was already paid
-        # inside the timed window, under-reporting throughput
-        done = 0
-        for x, _ in loader.epoch(epoch):
-            jax.block_until_ready(x)
-            done += 1
-        return done
+    def measure(async_input: bool):
+        # same transfer path as the trainer: NamedSharding over the device
+        # mesh (trainer.py builds the loader with exactly this sharding)
+        loader = Dataloader(
+            tr_x,
+            tr_y,
+            batch_size=batch,
+            seed=0,
+            host_augment=host_augment,
+            sharding=sharding,
+            async_input=async_input,
+        )
 
-    drain(0)  # warmup: native build + first device_put + sharding layout
-    t0 = time.perf_counter()
-    done = 0
-    epoch = 1
-    while done < steps:
-        done += drain(epoch)
-        epoch += 1
-    elapsed = time.perf_counter() - t0
-    return done * batch / elapsed
+        def drain(epoch):
+            # full epochs only: breaking mid-epoch would abandon staged
+            # prefetch batches whose gather/augment/put cost was already
+            # paid inside the timed window, under-reporting throughput.
+            # The wait accumulator times only the blocking next() — the
+            # block_until_ready consumer sync stands in for step compute.
+            done, wait = 0, 0.0
+            it = loader.epoch(epoch)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    x, _ = next(it)
+                except StopIteration:
+                    return done, wait
+                wait += time.perf_counter() - t0
+                jax.block_until_ready(x)
+                done += 1
+
+        drain(0)  # warmup: native build + first device_put + layout
+        t0 = time.perf_counter()
+        done, wait, epoch = 0, 0.0, 1
+        while done < steps:
+            d, w = drain(epoch)
+            done += d
+            wait += w
+            epoch += 1
+        elapsed = time.perf_counter() - t0
+        return done * batch / elapsed, wait / elapsed
+
+    async_v, async_wait = measure(True)
+    sync_v, sync_wait = measure(False)
+    extra = {
+        "sync_value": round(sync_v, 2),
+        "async_vs_sync": round(async_v / max(sync_v, 1e-9), 4),
+        "obs": {
+            "input_wait_frac": round(async_wait, 4),
+            "sync_input_wait_frac": round(sync_wait, 4),
+        },
+    }
+    return async_v, extra
 
 
 def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
@@ -763,7 +798,7 @@ def main() -> int:
     extra = {}
     unit = "images/sec/chip"
     if args.pipeline:
-        value = run_pipeline(args.batch, max(args.steps, 20))
+        value, extra = run_pipeline(args.batch, max(args.steps, 20))
         # no dtype component: the pipeline moves uint8 regardless of --dtype,
         # and the round-over-round series must not fragment on an unused flag
         metric = f"host_pipeline_b{args.batch}_{platform}"

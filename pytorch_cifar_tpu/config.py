@@ -52,6 +52,19 @@ class TrainConfig:
     # the jitted step — for CPU-only training where device augmentation
     # competes with model compute (native/cifar_native.cpp)
     host_augment: bool = False
+    # host-loader input pipeline (pipeline.Dataloader; the path taken when
+    # the device-resident data plane is off, e.g. with --host_augment):
+    #   prefetch     — bounded-queue depth: how many assembled device
+    #                  batches may be in flight ahead of the consumer.
+    #   async_input  — "on" (default) produces batches (native gather +
+    #                  host augment + device_put) on a background worker
+    #                  thread so input assembly and H2D overlap step
+    #                  dispatch; "off" keeps the inline refill path — the
+    #                  debugging escape hatch and the reference stream the
+    #                  equivalence tests compare against. Both settings
+    #                  yield bit-identical batches in identical order.
+    prefetch: int = 2
+    async_input: str = "on"
     # device-resident data plane (pipeline.DeviceDataset): stage the whole
     # dataset in HBM once and gather batches on device; only a ~200 KB
     # permutation crosses the host link per epoch. Measured necessity on

@@ -4,8 +4,10 @@ Replaces the reference's DataLoader worker pool + DistributedSampler
 (main.py:44-50, main_dist.py:109-127). Work split:
 
 - host (this module): shuffle an index permutation per epoch, gather uint8
-  slices, ``jax.device_put`` onto the batch-sharded mesh axis with one batch
-  of lookahead (double buffering);
+  slices, ``jax.device_put`` onto the batch-sharded mesh axis — by default
+  from a background producer thread feeding a bounded queue of ``prefetch``
+  batches (``async_input``), so assembly and the H2D transfer overlap step
+  dispatch; ``async_input=False`` keeps the inline double-buffer;
 - device (augment.py): crop/flip/normalize inside the jitted step.
 
 Sharding semantics match the reference's ``global batch / world_size``
@@ -25,6 +27,8 @@ shards via ``jax.make_array_from_process_local_data`` — a plain
 from __future__ import annotations
 
 import collections
+import queue as queue_lib
+import threading
 import time
 from typing import Iterator, Optional, Tuple
 
@@ -100,6 +104,7 @@ class Dataloader:
         sharding: Optional[jax.sharding.Sharding] = None,
         label_sharding: Optional[jax.sharding.Sharding] = None,
         prefetch: int = 2,
+        async_input: bool = True,
         host_augment: bool = False,
         augment_padding: int = 4,
         augment_flip: bool = True,
@@ -131,6 +136,15 @@ class Dataloader:
         self.seed = seed
         self.sharding = sharding
         self.prefetch = max(1, prefetch)
+        # async_input=True (the production default, --async_input) moves
+        # batch assembly AND the host->device put onto a dedicated worker
+        # thread feeding a bounded queue of depth `prefetch`, so input
+        # production overlaps step dispatch instead of executing inline
+        # between dispatches. False keeps the inline double-buffer path —
+        # the debugging escape hatch and the reference the equivalence
+        # test compares against (both yield bit-identical batches in
+        # identical order: same generator, one producer, FIFO queue).
+        self.async_input = async_input
         # CPU-mode augmentation in the native data plane (crop+flip on the
         # host, native/cifar_native.cpp) — used with a train step built with
         # augment=False; on TPU the on-device path (augment.py) is faster
@@ -144,6 +158,21 @@ class Dataloader:
         # both into the obs block. None = zero-cost (one is-None check).
         self._obs_hist = (
             registry.histogram("data.host_batch_ms")
+            if registry is not None
+            else None
+        )
+        # async-pipeline instruments: queue depth AFTER each consumer take
+        # (sustained 0 = producer-bound input, sustained ~prefetch = the
+        # healthy state where the chip is the bottleneck) and the producer
+        # thread's full per-batch cost (gather + augment + put dispatch —
+        # the work the async path moves OFF the training thread)
+        self._obs_depth = (
+            registry.gauge("data.prefetch_depth")
+            if registry is not None
+            else None
+        )
+        self._obs_producer = (
+            registry.histogram("data.producer_batch_ms")
             if registry is not None
             else None
         )
@@ -229,9 +258,15 @@ class Dataloader:
                     )
                 yield x, y
 
-        # double-buffer: keep `prefetch` batches in flight on device
-        queue = collections.deque()
         it = host_batches()
+        if self.async_input:
+            # background prefetcher: assembly + H2D on a worker thread
+            yield from self._async_epoch(it)
+            return
+        # inline double-buffer (--async_input off): keep `prefetch`
+        # batches in flight on device, refilled on the training thread
+        # between step dispatches — the synchronous reference path
+        queue = collections.deque()
         try:
             while True:
                 while len(queue) < self.prefetch:
@@ -241,6 +276,84 @@ class Dataloader:
         except StopIteration:
             while queue:
                 yield queue.popleft()
+
+    def _async_epoch(self, it) -> Iterator[Tuple[jax.Array, jax.Array]]:
+        """Drain ``it`` (one epoch's host batches) through a background
+        producer thread.
+
+        The worker runs the SAME generator the inline path consumes —
+        native gather, host augmentation (one sequential rng stream),
+        multi-process ``make_array_from_process_local_data`` slab
+        assembly, and the ``_put`` H2D transfer — and feeds finished
+        device batches into a bounded FIFO queue of depth ``prefetch``,
+        so production overlaps the training thread's step dispatches.
+        One producer + FIFO ordering makes the yielded stream
+        bit-identical, in identical order, to ``async_input=False``
+        (pinned by tests/test_data.py).
+
+        Shutdown contract: a consumer that stops early — sentinel
+        rollback breaking the epoch loop, ``Trainer.request_stop``, an
+        exception in the step — closes this generator; the ``finally``
+        block stops the producer, unblocks a full-queue put by draining,
+        and joins the thread, so no thread outlives the epoch. Producer
+        exceptions are re-queued and re-raised HERE, on the consumer
+        thread, with their original tracebacks — never swallowed.
+
+        Concurrency shape (graftcheck unlocked-shared-mutation): all
+        cross-thread state is local to this call and internally
+        synchronized (queue.Queue, threading.Event); the worker mutates
+        no shared attributes.
+        """
+        q: queue_lib.Queue = queue_lib.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def produce():
+            try:
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        x, y = next(it)
+                    except StopIteration:
+                        q.put(("end", None))
+                        return
+                    batch = self._put(x, y)
+                    if self._obs_producer is not None:
+                        self._obs_producer.observe(
+                            (time.perf_counter() - t0) * 1e3
+                        )
+                    # blocking put = backpressure at `prefetch` batches;
+                    # a shutdown mid-put is unblocked by the consumer's
+                    # drain below, and the loop re-checks `stop` before
+                    # producing more
+                    q.put(("ok", batch))
+            except BaseException as e:  # re-raised on the consumer thread
+                q.put(("err", e))
+
+        worker = threading.Thread(
+            target=produce, name="input-prefetch", daemon=True
+        )
+        worker.start()
+        try:
+            while True:
+                kind, payload = q.get()
+                if self._obs_depth is not None:
+                    self._obs_depth.set(q.qsize())
+                if kind == "end":
+                    return
+                if kind == "err":
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+            # unblock a producer parked on a full queue (maxsize >= 1, so
+            # after one drain its pending put always succeeds and the
+            # loop exits on `stop`)
+            while True:
+                try:
+                    q.get_nowait()
+                except queue_lib.Empty:
+                    break
+            worker.join(timeout=30.0)
 
     def _put(self, x: np.ndarray, y: np.ndarray):
         if jax.process_count() > 1:
@@ -420,7 +533,10 @@ class DeviceDataset:
         computes it on device — zero per-epoch H2D; otherwise the host
         permutation is uploaded (~200 KB — the only per-epoch transfer of
         the device data plane). shuffle=False reuses one staged identity
-        permutation forever."""
+        permutation forever — only valid for consumers that do NOT donate
+        the perm (the eval epoch fn); the train epoch fn donates its perm
+        argument (parallel/dp.py), which is safe precisely because
+        shuffle=True stages a fresh array every epoch."""
         if not self.shuffle:
             return self._perm_static
         with trace.span("data/staged_perm", epoch=epoch):

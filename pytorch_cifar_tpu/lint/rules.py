@@ -785,13 +785,26 @@ class HostSync(Rule):
 # 5. donation-misuse
 # ---------------------------------------------------------------------
 
+# Donation THROUGH the data-parallel wrapper jits (parallel/dp.py) — the
+# rule's former blind spot: `step = data_parallel_train_step(fn, mesh)`
+# produces a callable that donates these positions unless built with
+# donate=False. The table mirrors dp.py's donate_argnums — a position
+# change there must land here in the same PR (pinned by the dp.py
+# docstrings and tests/test_lint.py fixtures; STATIC_ANALYSIS.md).
+_WRAPPER_DONATIONS = {
+    "data_parallel_train_step": (0, 1),   # state, (images, labels)
+    "data_parallel_train_epoch": (0, 1, 4),  # state, totals, perm
+}
+
 
 class DonationMisuse(Rule):
     name = "donation-misuse"
     summary = (
-        "an argument donated via donate_argnums is read again after the "
-        "jitted call — the buffer was handed to XLA and may already hold "
-        "the output (garbage reads, or the donate-same-buffer abort)"
+        "an argument donated via donate_argnums — or through a dp.py "
+        "wrapper jit (data_parallel_train_step/epoch) — is read again "
+        "after the jitted call: the buffer was handed to XLA and may "
+        "already hold the output (garbage reads, or the "
+        "donate-same-buffer abort)"
     )
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
@@ -803,22 +816,36 @@ class DonationMisuse(Rule):
 
     @staticmethod
     def _donated_positions(call: ast.Call) -> Optional[List[int]]:
-        if qualname(call.func) not in ("jax.jit", "jit"):
+        q = qualname(call.func)
+        if q in ("jax.jit", "jit"):
+            for kw in call.keywords:
+                if kw.arg != "donate_argnums":
+                    continue
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return [v.value]
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    pos = []
+                    for e in v.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                            e.value, int
+                        ):
+                            pos.append(e.value)
+                    return pos
             return None
-        for kw in call.keywords:
-            if kw.arg != "donate_argnums":
-                continue
-            v = kw.value
-            if isinstance(v, ast.Constant) and isinstance(v.value, int):
-                return [v.value]
-            if isinstance(v, (ast.Tuple, ast.List)):
-                pos = []
-                for e in v.elts:
-                    if isinstance(e, ast.Constant) and isinstance(
-                        e.value, int
-                    ):
-                        pos.append(e.value)
-                return pos
+        # dp.py wrapper jits: donate by default; an explicit donate=False
+        # turns it off (any other value — a variable, True — keeps the
+        # conservative default: donated)
+        wrapped = _WRAPPER_DONATIONS.get((q or "").rsplit(".", 1)[-1])
+        if wrapped is not None:
+            for kw in call.keywords:
+                if (
+                    kw.arg == "donate"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                ):
+                    return None
+            return list(wrapped)
         return None
 
     def _check_fn(self, ctx: ModuleCtx, fn) -> List[Finding]:
@@ -846,12 +873,20 @@ class DonationMisuse(Rule):
                     pos = donating.get(node.func.id)
                     if pos is None:
                         continue
-                    donated_names = {
-                        node.args[p].id
-                        for p in pos
-                        if p < len(node.args)
-                        and isinstance(node.args[p], ast.Name)
-                    }
+                    donated_names = set()
+                    for p in pos:
+                        if p >= len(node.args):
+                            continue
+                        arg = node.args[p]
+                        if isinstance(arg, ast.Name):
+                            donated_names.add(arg.id)
+                        elif isinstance(arg, (ast.Tuple, ast.List)):
+                            # batch tuples: step(state, (images, labels),
+                            # rng) donates every buffer in the pytree
+                            donated_names.update(
+                                e.id for e in arg.elts
+                                if isinstance(e, ast.Name)
+                            )
                     if not donated_names:
                         continue
                     # names STORED anywhere inside the same statement

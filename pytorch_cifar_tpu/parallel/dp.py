@@ -102,6 +102,16 @@ def data_parallel_train_step(
 
     step_fn: (state, (images, labels), rng) -> (state, metrics), already
     containing the pmean/psum collectives for grads/stats/metrics.
+
+    ``donate=True`` donates the state AND the per-step batch buffers
+    (argnums 0 and 1): the loader hands each device batch to exactly one
+    step call and never reads it back, so donating the images/labels
+    buffers lets XLA alias them for the step's outputs — free HBM and
+    copy savings with the async input pipeline keeping ``prefetch``
+    batches in flight (XLA:CPU ignores input donation with a warning).
+    graftcheck's donation-misuse rule traces reads-after-donate through
+    this wrapper (STATIC_ANALYSIS.md) — keep its wrapper table in sync
+    when changing the donated positions.
     """
     from pytorch_cifar_tpu import tpu_compiler_options
 
@@ -114,7 +124,7 @@ def data_parallel_train_step(
     )
     return jax.jit(
         mapped,
-        donate_argnums=(0,) if donate else (),
+        donate_argnums=(0, 1) if donate else (),
         compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 
@@ -151,6 +161,14 @@ def data_parallel_train_epoch(
     carves out its own batch rows by ``axis_index`` INSIDE the scan body —
     there is no per-step host involvement at all, which is the point
     (one dispatch per epoch; see make_train_epoch).
+
+    ``donate=True`` donates the state, the zero-metrics totals, and the
+    epoch PERMUTATION (argnums 0, 1, 4): ``staged_perm`` materializes a
+    fresh permutation per epoch and only this one dispatch ever reads
+    it, so its buffer is free for XLA to reuse the moment the gather
+    consumes it. The dataset arrays (argnums 2, 3) are deliberately NOT
+    donated — they persist across every epoch. Mirrored in graftcheck's
+    donation-misuse wrapper table (STATIC_ANALYSIS.md).
     """
     from pytorch_cifar_tpu import tpu_compiler_options
 
@@ -163,7 +181,7 @@ def data_parallel_train_epoch(
     )
     return jax.jit(
         mapped,
-        donate_argnums=(0, 1) if donate else (),
+        donate_argnums=(0, 1, 4) if donate else (),
         compiler_options=tpu_compiler_options(mesh.devices.flat[0], model=model_name),
     )
 

@@ -124,6 +124,10 @@ class Trainer:
         # derived BEFORE the mesh section because the spatial_w guard needs
         # the effective data-plane decision, not the raw flags
         host_aug = config.host_augment and config.random_crop
+        if config.async_input not in ("on", "off"):
+            raise ValueError(
+                f"async_input must be on/off, got {config.async_input!r}"
+            )
         device_data = config.device_data and not host_aug
 
         # -- mesh ------------------------------------------------------
@@ -227,6 +231,8 @@ class Trainer:
                 seed=config.seed,
                 sharding=sharding,
                 label_sharding=lbl_sharding,
+                prefetch=config.prefetch,
+                async_input=config.async_input == "on",
                 host_augment=host_aug,
                 augment_flip=config.random_flip,
                 registry=self.obs,

@@ -141,6 +141,13 @@ def test_bench_pipeline_mode_prints_one_json_line():
     # no dtype component: the pipeline moves uint8 regardless of --dtype
     assert rec["metric"] == "host_pipeline_b64_cpu", rec["metric"]
     assert rec["value"] > 0
+    # the async-input A/B rides the same record (PR 6): headline value is
+    # the async (production-default) loader, sync figure + ratio + the
+    # consumer wait fractions land in the contract
+    assert rec["sync_value"] > 0
+    assert rec["async_vs_sync"] > 0
+    assert 0.0 <= rec["obs"]["input_wait_frac"] <= 1.0
+    assert 0.0 <= rec["obs"]["sync_input_wait_frac"] <= 1.0
 
 
 def test_bench_config_mode_prints_one_json_line():

@@ -54,6 +54,7 @@ def test_normalize_stats():
 def test_random_crop_preserves_shape_and_content_domain():
     key = jax.random.PRNGKey(0)
     x = jax.random.randint(key, (8, 32, 32, 3), 0, 256, jnp.int32).astype(jnp.uint8)
+    # graftcheck: noqa[prng-reuse] -- test fixture: data-gen and crop sharing one key is harmless here; the test only checks shape/domain
     out = random_crop(key, x)
     assert out.shape == x.shape and out.dtype == x.dtype
     # different key -> different crops (with overwhelming probability)
@@ -77,11 +78,13 @@ def test_crop_flip_onehot_matches_gather_path():
     x = jax.random.randint(key, (16, 32, 32, 3), 0, 256, jnp.int32).astype(
         jnp.uint8
     )
+    # graftcheck: noqa[prng-reuse] -- deliberate: the test DEFINES bit-identity of two augmentation paths under the same key, so both must consume identical bits
     kc, kf = jax.random.split(key)
     ref = random_hflip(kf, random_crop(kc, x)).astype(jnp.float32)
     fused = crop_flip_onehot(key, x, flip=True)
     np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))
     # crop-only variant
+    # graftcheck: noqa[prng-reuse] -- deliberate: same-key equality is the property under test (crop-only fused arm vs the reference crop)
     ref_c = random_crop(kc, x).astype(jnp.float32)
     fused_c = crop_flip_onehot(key, x, flip=False)
     np.testing.assert_array_equal(np.asarray(fused_c), np.asarray(ref_c))
@@ -151,6 +154,144 @@ def test_dataloader_drop_last_still_drops():
     batches = list(dl.epoch(0))
     assert len(dl) == len(batches) == 4
     assert all(np.asarray(b[1]).min() >= 0 for b in batches)
+
+
+def test_async_loader_bit_identical_to_sync_single_device():
+    """The background-prefetch pipeline (async_input=True, the production
+    default) must yield BIT-IDENTICAL batches in IDENTICAL order to the
+    inline path — same epoch-seeded shuffle, same shared augmentation rng
+    stream, same wrap-padded ragged tail — so flipping --async_input can
+    never change a training trajectory. host_augment exercises the
+    sequential aug-rng draws (any reordering in the producer would shift
+    the stream and fail here)."""
+    n, bs = 70, 16
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 256, (n, 32, 32, 3), np.uint8)
+    y = rs.randint(0, 10, (n,)).astype(np.int32)
+    a = Dataloader(
+        x, y, batch_size=bs, drop_last=False, seed=9,
+        host_augment=True, async_input=True, prefetch=3,
+    )
+    s = Dataloader(
+        x, y, batch_size=bs, drop_last=False, seed=9,
+        host_augment=True, async_input=False,
+    )
+    for epoch in (0, 3):
+        got_a = [(np.asarray(bx), np.asarray(by)) for bx, by in a.epoch(epoch)]
+        got_s = [(np.asarray(bx), np.asarray(by)) for bx, by in s.epoch(epoch)]
+        assert len(got_a) == len(got_s) == len(a)
+        for (ax, ay), (sx, sy) in zip(got_a, got_s):
+            np.testing.assert_array_equal(ax, sx)
+            np.testing.assert_array_equal(ay, sy)
+        # ragged final batch: wrap-pad ordering survives the async path —
+        # every image exactly once, pad rows confined to the tail under
+        # -1 labels (pad PIXELS equal the sync path's bit-for-bit per the
+        # zip above; they differ from the epoch's first rows only by
+        # their independent augmentation draws)
+        ys = np.concatenate([g[1] for g in got_a])
+        valid = ys >= 0
+        assert valid.sum() == n
+        np.testing.assert_array_equal(
+            np.where(~valid)[0], np.arange(n, bs * len(a))
+        )
+
+
+def test_async_loader_bit_identical_to_sync_sharded():
+    """Same guarantee over the forced-8-device mesh: the producer thread
+    runs the sharded ``_put`` (and would run the multi-process slab
+    assembly under multihost — same code path, process-local), and the
+    resulting arrays carry the same sharding as the sync path's."""
+    from pytorch_cifar_tpu.parallel import batch_sharding, make_mesh
+
+    n, bs = 70, 16
+    rs = np.random.RandomState(1)
+    x = rs.randint(0, 256, (n, 32, 32, 3), np.uint8)
+    y = rs.randint(0, 10, (n,)).astype(np.int32)
+    sh = batch_sharding(make_mesh())
+    a = Dataloader(
+        x, y, batch_size=bs, drop_last=False, seed=5, sharding=sh,
+        async_input=True,
+    )
+    s = Dataloader(
+        x, y, batch_size=bs, drop_last=False, seed=5, sharding=sh,
+        async_input=False,
+    )
+    for (ax, ay), (sx, sy) in zip(a.epoch(2), s.epoch(2)):
+        np.testing.assert_array_equal(np.asarray(ax), np.asarray(sx))
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(sy))
+        assert ax.sharding.is_equivalent_to(sx.sharding, ax.ndim)
+
+
+def test_async_loader_producer_exception_reraised_on_consumer():
+    """A producer-thread failure (gather, augment, or the device put) must
+    re-raise on the CONSUMER thread with its original type — never be
+    swallowed, never hang the iterator — and still leave no live
+    prefetch thread behind."""
+    import threading
+
+    import pytest
+
+    class BoomLoader(Dataloader):
+        def _put(self, x, y):
+            if not hasattr(self, "_puts"):
+                self._puts = 0
+            self._puts += 1
+            if self._puts >= 3:
+                raise RuntimeError("injected producer failure")
+            return super()._put(x, y)
+
+    x = np.zeros((64, 32, 32, 3), np.uint8)
+    y = np.arange(64, dtype=np.int32)
+    dl = BoomLoader(x, y, batch_size=16, seed=0, async_input=True)
+    with pytest.raises(RuntimeError, match="injected producer failure"):
+        list(dl.epoch(0))
+    for t in threading.enumerate():
+        assert not (t.name == "input-prefetch" and t.is_alive())
+
+
+def test_async_loader_clean_shutdown_mid_epoch():
+    """Abandoning the iterator mid-epoch (sentinel rollback, request_stop,
+    a crash in the step loop) must stop and join the producer thread:
+    no live prefetch thread, and no new non-daemon thread, survives the
+    generator's close."""
+    import threading
+
+    non_daemon_before = {
+        t.ident for t in threading.enumerate() if not t.daemon
+    }
+    x = np.zeros((128, 32, 32, 3), np.uint8)
+    y = np.arange(128, dtype=np.int32)
+    dl = Dataloader(x, y, batch_size=16, seed=0, async_input=True)
+    it = dl.epoch(0)
+    next(it)
+    next(it)
+    it.close()  # mid-epoch shutdown
+    for t in threading.enumerate():
+        assert not (t.name == "input-prefetch" and t.is_alive())
+        if not t.daemon:
+            assert t.ident in non_daemon_before, t
+    # the loader remains usable: a fresh epoch restarts cleanly
+    assert len(list(dl.epoch(1))) == len(dl)
+
+
+def test_async_loader_obs_instruments():
+    """The async pipeline's obs contract (OBSERVABILITY.md): a
+    ``data.prefetch_depth`` gauge bounded by the queue depth, and the
+    producer-thread ``data.producer_batch_ms`` histogram covering every
+    batch (assembly + put, timed OFF the consumer thread)."""
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    x = np.zeros((96, 32, 32, 3), np.uint8)
+    y = np.arange(96, dtype=np.int32)
+    dl = Dataloader(
+        x, y, batch_size=16, seed=0, async_input=True, prefetch=2,
+        registry=reg,
+    )
+    nb = len(list(dl.epoch(0)))
+    s = reg.summary()
+    assert s["data.producer_batch_ms.count"] == nb
+    assert 0.0 <= s["data.prefetch_depth.max"] <= 2.0
 
 
 def test_device_dataset_matches_host_loader_bitexact():

@@ -430,6 +430,64 @@ def test_donation_misuse_negative(tmp_path):
     assert run_rule(tmp_path, src, "donation-misuse") == []
 
 
+def test_donation_misuse_traces_dp_wrappers_positive(tmp_path):
+    # the former blind spot (STATIC_ANALYSIS.md known limits, pre-PR 6):
+    # donation THROUGH a dp.py wrapper jit. The wrapper donates the state
+    # and the batch tuple, so reading a batch buffer after the call is
+    # exactly the literal-jax.jit bug in wrapper clothing.
+    src = """
+    from pytorch_cifar_tpu.parallel import data_parallel_train_step
+
+    def run(fn, mesh, state, xd, yd, rng):
+        step = data_parallel_train_step(fn, mesh)
+        state2, m = step(state, (xd, yd), rng)
+        return state2, xd.sum()  # xd's buffer was donated via the wrapper
+    """
+    found = run_rule(tmp_path, src, "donation-misuse")
+    assert len(found) == 1 and "'xd'" in found[0].message
+
+    # the epoch wrapper donates (state, totals, perm) — a perm re-read is
+    # the shuffle=False-staged-perm trap the dp.py docstring warns about
+    src2 = """
+    from pytorch_cifar_tpu.parallel import data_parallel_train_epoch
+
+    def run(fn, mesh, state, totals, images, labels, perm, rng):
+        epoch = data_parallel_train_epoch(fn, mesh)
+        state, totals = epoch(state, totals, images, labels, perm, rng)
+        return state, totals, perm
+    """
+    found2 = run_rule(tmp_path, src2, "donation-misuse", "b.py")
+    assert len(found2) == 1 and "'perm'" in found2[0].message
+
+
+def test_donation_misuse_traces_dp_wrappers_negative(tmp_path):
+    # rebind idiom through the wrapper, donate=False, and reads of the
+    # NON-donated dataset arguments (epoch argnums 2/3) all stay quiet
+    src = """
+    from pytorch_cifar_tpu.parallel import (
+        data_parallel_train_epoch,
+        data_parallel_train_step,
+    )
+
+    def run(fn, mesh, state, batches, rng):
+        step = data_parallel_train_step(fn, mesh)
+        for b in batches:
+            state, m = step(state, b, rng)
+        return state, m
+
+    def undonated(fn, mesh, state, xd, yd, rng):
+        step = data_parallel_train_step(fn, mesh, donate=False)
+        state2, m = step(state, (xd, yd), rng)
+        return state2, xd.sum()
+
+    def epoch(fn, mesh, state, totals, images, labels, perm, rng):
+        run_epoch = data_parallel_train_epoch(fn, mesh)
+        state, totals = run_epoch(state, totals, images, labels, perm, rng)
+        return state, totals, images.shape, labels.shape
+    """
+    assert run_rule(tmp_path, src, "donation-misuse") == []
+
+
 def test_unlocked_shared_mutation_positive(tmp_path):
     # the pre-fix CheckpointWatcher shape: a polling thread mutates
     # observable counters with no lock anywhere
@@ -647,6 +705,20 @@ def test_checked_in_baseline_is_valid_and_not_stale():
     run = lint_paths([PKG, os.path.join(REPO, "tools")], repo_root=REPO)
     stale = match_baseline(run.findings, entries, run.files)
     assert stale == [], stale
+
+
+def test_precommit_hook_ships_and_targets_changed_mode():
+    """The checked-in pre-commit hook (installed via `git config
+    core.hooksPath tools/githooks`) must stay executable and keep routing
+    through `tools/lint.py --changed` — the wiring STATIC_ANALYSIS.md
+    documents. The end-to-end block-a-seeded-finding drill lives in
+    test_tools.py (subprocess-weight); this pins the contract in tier-1."""
+    hook = os.path.join(REPO, "tools", "githooks", "pre-commit")
+    assert os.path.isfile(hook)
+    assert os.access(hook, os.X_OK), "hook lost its executable bit"
+    with open(hook) as f:
+        src = f.read()
+    assert "tools/lint.py" in src and "--changed" in src
 
 
 def test_json_report_schema():

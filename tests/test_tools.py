@@ -445,6 +445,75 @@ def test_lint_cli_changed_mode(tmp_path):
     assert "committed.py" not in r.stdout
 
 
+def test_precommit_hook_blocks_seeded_finding(tmp_path):
+    """tools/githooks/pre-commit (the `git config core.hooksPath
+    tools/githooks` install) runs `tools/lint.py --changed` and must exit
+    1 on a seeded finding in a fixture git repo — blocking the commit —
+    then exit 0 once the finding is fixed."""
+    import shutil
+    import stat
+    import subprocess as sp
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = dict(os.environ)
+    env.update(
+        GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+        GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+        PYTHON=sys.executable,
+    )
+
+    def git(*args):
+        sp.run(["git", *args], cwd=repo, check=True, env=env,
+               capture_output=True)
+
+    git("init", "-q")
+    # transplant the hook + CLI + pure-stdlib lint package into the
+    # fixture repo so the hook's `git rev-parse` root IS the fixture
+    tools = repo / "tools"
+    (tools / "githooks").mkdir(parents=True)
+    for rel in (("tools", "lint.py"), ("tools", "githooks", "pre-commit")):
+        shutil.copy(os.path.join(REPO, *rel), tools / os.path.join(*rel[1:]))
+    hook = tools / "githooks" / "pre-commit"
+    hook.chmod(hook.stat().st_mode | stat.S_IXUSR)
+    pkg = repo / "pytorch_cifar_tpu"
+    shutil.copytree(
+        os.path.join(REPO, "pytorch_cifar_tpu", "lint"), pkg / "lint"
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text("")
+    git("config", "core.hooksPath", "tools/githooks")
+
+    dirty = repo / "dirty.py"
+    dirty.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    git("add", "dirty.py")
+    # the hook script itself exits 1 on the seeded finding...
+    r = sp.run([str(hook)], cwd=repo, env=env, capture_output=True,
+               text=True, timeout=120)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "dirty.py" in r.stdout and "[prng-reuse]" in r.stdout
+    # ...and a real `git commit` through core.hooksPath is blocked by it
+    c = sp.run(["git", "commit", "-qm", "seed"], cwd=repo, env=env,
+               capture_output=True, text=True, timeout=120)
+    assert c.returncode != 0, (c.stdout, c.stderr)
+    # fixed code sails through: hook exits 0, commit lands
+    dirty.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    ka, kb = jax.random.split(key)\n"
+        "    return jax.random.bernoulli(ka), jax.random.bernoulli(kb)\n"
+    )
+    git("add", "dirty.py")
+    r = sp.run([str(hook)], cwd=repo, env=env, capture_output=True,
+               text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    git("commit", "-qm", "clean")
+
+
 def test_zoo_bench_smoke(tmp_path):
     """zoo_bench end-to-end on CPU: clamps, benches, writes the JSON
     artifact this repo's family table is built from."""
