@@ -423,6 +423,100 @@ def run_pipeline(batch: int, steps: int, host_augment: bool = True):
     return async_v, extra
 
 
+def run_ckpt(model: str, compute_dtype):
+    """Checkpoint + cold-start A/B (ROBUSTNESS.md async writer,
+    SERVING.md AOT cache). Two measurements ride one record:
+
+    - **async vs sync save stall**: the SAME state is saved N times in
+      each mode; the headline ``value`` is the stall speedup
+      (sync_stall / async_stall — trainer-thread blocked time per save),
+      and the saved files are required to be bit-identical between the
+      modes (``bit_identical``). writer_ms (the background commit cost
+      the async mode moved off-thread) rides along.
+    - **engine cold start with/without a warm AOT cache**: engine #1
+      compiles and exports; engine #2 must import with ZERO bucket
+      compiles and bit-identical logits.
+    """
+    import statistics
+    import tempfile
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve import InferenceEngine
+    from pytorch_cifar_tpu.train.checkpoint import (
+        AsyncCheckpointWriter,
+        save_checkpoint,
+    )
+
+    state = build_state(model, 8, compute_dtype)
+    jax.block_until_ready(state.params)
+    saves = 6  # first save of each mode is warmup (mkdir, thread start)
+
+    def read_payload(d):
+        with open(os.path.join(d, "ckpt.msgpack"), "rb") as f:
+            return f.read()
+
+    with tempfile.TemporaryDirectory(prefix="bench_ckpt_") as work:
+        reg = MetricsRegistry()
+        sync_dir = os.path.join(work, "sync")
+        sync_stalls = []
+        for i in range(saves):
+            t0 = time.perf_counter()
+            save_checkpoint(sync_dir, state, i, 0.0, registry=reg)
+            sync_stalls.append((time.perf_counter() - t0) * 1e3)
+        async_dir = os.path.join(work, "async")
+        writer = AsyncCheckpointWriter(registry=reg)
+        async_stalls = []
+        for i in range(saves):
+            t0 = time.perf_counter()
+            save_checkpoint(
+                async_dir, state, i, 0.0, registry=reg, writer=writer
+            )
+            async_stalls.append((time.perf_counter() - t0) * 1e3)
+            writer.flush()  # outside the stall timer: commit latency is
+            # the writer's, not the trainer thread's
+        writer.close()
+        payload = read_payload(sync_dir)
+        bit_identical = payload == read_payload(async_dir)
+
+        cache = os.path.join(work, "aot")
+        buckets = (1, 8)
+        t0 = time.perf_counter()
+        e1 = InferenceEngine.from_random(
+            model, buckets=buckets, compute_dtype=compute_dtype,
+            aot_cache_dir=cache,
+        )
+        cold_no_cache = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        e2 = InferenceEngine.from_random(
+            model, buckets=buckets, compute_dtype=compute_dtype,
+            aot_cache_dir=cache,
+        )
+        cold_warm = time.perf_counter() - t0
+        rs = np.random.RandomState(0)
+        x = rs.randint(0, 256, size=(5, 32, 32, 3)).astype(np.uint8)
+        logits_match = bool(np.array_equal(e1.predict(x), e2.predict(x)))
+
+        s = reg.summary()
+        sync_ms = statistics.median(sync_stalls[1:])
+        async_ms = statistics.median(async_stalls[1:])
+    extra = {
+        "sync_stall_ms": round(sync_ms, 3),
+        "async_stall_ms": round(async_ms, 3),
+        "writer_ms_p50": round(s.get("checkpoint.writer_ms.p50", 0.0), 3),
+        "saved_bytes": len(payload),
+        "bit_identical": bit_identical,
+        "cold_start": {
+            "no_cache_s": round(cold_no_cache, 3),
+            "warm_cache_s": round(cold_warm, 3),
+            "compiles_no_cache": e1.compile_count,
+            "compiles_warm": e2.compile_count,
+            "cache_hits": e2.aot_cache_hits,
+            "logits_match": logits_match,
+        },
+    }
+    return sync_ms / max(async_ms, 1e-9), extra
+
+
 def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
     """Serving-side north-star: closed-loop request latency + img/s
     through the full serve stack (bucket-compiled engine + micro-batcher;
@@ -758,6 +852,13 @@ def main() -> int:
         "closed-loop synthetic clients, p50/p95/p99 latency in the record",
     )
     parser.add_argument(
+        "--ckpt", action="store_true",
+        help="measure the checkpoint layer: async-vs-sync save stall "
+        "(trainer-thread blocked time, bit-identical files required) and "
+        "engine cold start with/without a warm AOT executable cache "
+        "(ROBUSTNESS.md / SERVING.md); value = stall speedup (x)",
+    )
+    parser.add_argument(
         "--chaos-smoke", action="store_true", dest="chaos_smoke",
         help="run one kill-mid-epoch -> resume cycle through "
         "tools/chaos_run.py and report RECOVERY TIME (seconds) in the "
@@ -781,6 +882,7 @@ def main() -> int:
         or args.epoch
         or args.step
         or args.serve
+        or args.ckpt
         or args.config is not None
     ):
         # the scoreboard default: orchestrate fresh-process captures of the
@@ -802,6 +904,12 @@ def main() -> int:
         # no dtype component: the pipeline moves uint8 regardless of --dtype,
         # and the round-over-round series must not fragment on an unused flag
         metric = f"host_pipeline_b{args.batch}_{platform}"
+    elif args.ckpt:
+        value, extra = run_ckpt(args.model, compute_dtype)
+        # stall ratio, not a throughput: higher = more save latency
+        # hidden from the training thread at equal checkpoint bytes
+        unit = "x"
+        metric = f"ckpt_async_stall_{args.model}_{platform}"
     elif args.serve:
         report = run_serve(args.model, args.batch, args.steps, compute_dtype)
         value = report["img_per_sec"]
@@ -861,7 +969,7 @@ def main() -> int:
         extra = {"obs": obs}
         name = f"train_throughput_{args.model}_b{args.batch}"
 
-    if not args.pipeline:
+    if not (args.pipeline or args.ckpt):
         metric = f"{name}_{args.dtype}_{platform}"
     rec = core_record(metric, value, unit=unit)
     rec.update(extra)

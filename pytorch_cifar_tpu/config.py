@@ -123,14 +123,20 @@ class TrainConfig:
 
     # checkpointing (reference: main.py:136-148)
     output_dir: str = "./checkpoint"
-    # On an accuracy improvement the best state is snapshotted ON DEVICE
-    # (a cheap device-to-device copy) and written to disk by a background
-    # thread; fit() flushes the newest snapshot before returning. Through
-    # a slow host link a synchronous ~100 MB device_get+write costs ~14 s
-    # — 10x the epoch it interrupts (measured). False = write
-    # synchronously inside maybe_checkpoint (the reference's torch.save
-    # timing, main.py:140-147).
-    async_checkpoint: bool = True
+    # Overlapped checkpoint writes (checkpoint.AsyncCheckpointWriter):
+    #   "on"  — a save does only the device_get snapshot on the training
+    #           thread; serialization + CRC + the fsync'd tmp+rename
+    #           commit run on a background writer thread, bounded to ONE
+    #           pending save (a newer save supersedes a queued one),
+    #           writer errors re-raised on the next trainer interaction,
+    #           clean join on shutdown. The best state is additionally
+    #           snapshotted ON DEVICE on every improvement so the
+    #           pipelined fit's buffer donation can never invalidate it.
+    #   "off" — write synchronously inside maybe_checkpoint (the
+    #           reference's torch.save timing, main.py:140-147) — the
+    #           debugging escape hatch, mirroring --async_input. Both
+    #           settings produce bit-identical checkpoint files.
+    async_save: str = "on"
     # Rate-limit DISK writes of the best-state snapshot to once per this
     # many epochs (plus the first improvement and a final flush). Even a
     # background ~100 MB device_get stalls training ~14 s when the host
@@ -242,6 +248,18 @@ class ServeConfig:
     # verify bit-identity of the padded bucket path against a direct
     # unpadded jitted forward before serving (one extra compile)
     verify: bool = False
+
+    # AOT executable cache (SERVING.md): export each compiled bucket
+    # program to this directory and import instead of recompiling on the
+    # next cold start, so a fresh replica boots in load time with ZERO
+    # bucket compiles. Every import is verified by a probe batch checked
+    # bit-identical against the entry's stored expectation (and one
+    # bucket against a freshly compiled reference) — this container's
+    # jaxlib 0.4.36 mis-executes deserialized executables on CPU under
+    # donation (ROBUSTNESS.md), so imports are never trusted blindly; a
+    # refuted entry is marked poisoned and the engine falls back to
+    # compiling. "" = no cache.
+    aot_cache: str = ""
 
     # observability (OBSERVABILITY.md): host-span trace file, periodic
     # JSONL metrics (queue depth, batch occupancy, admission-to-completion

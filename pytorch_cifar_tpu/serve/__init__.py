@@ -16,6 +16,10 @@ becomes a long-lived prediction service:
   engine without dropping in-flight requests.
 - :mod:`~pytorch_cifar_tpu.serve.loadgen` is the synthetic closed-loop
   load generator behind ``serve.py`` and ``bench.py --serve``.
+- :mod:`~pytorch_cifar_tpu.serve.aot_cache` exports/imports the compiled
+  bucket executables (``--aot_cache``), so a fresh replica cold-starts in
+  load time with zero compiles — every import probe-verified
+  (SERVING.md "AOT executable cache").
 
 See SERVING.md for the architecture and tuning knobs.
 """

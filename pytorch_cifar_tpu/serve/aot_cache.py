@@ -1,0 +1,192 @@
+"""AOT executable cache: export/import compiled bucket programs.
+
+A cold XLA compile is the single most expensive event in a serving
+process (seconds on CPU, minutes through the tunneled TPU transport) and
+every fresh replica used to re-pay it per bucket at warmup. This module
+lets :meth:`InferenceEngine.warmup` export each compiled bucket program
+(``jax.jit(...).lower(...).compile()`` → serialized executable) to a
+cache directory and import it on the next cold start, so a replica boots
+in load time with ``compile_count == 0`` (SERVING.md).
+
+Design constraints:
+
+- **Keyed by everything that invalidates an executable.** The entry
+  filename embeds a fingerprint over model name/bucket/num_classes/image
+  shape/compute dtype/normalization constants/mesh shape + platform/jax +
+  jaxlib versions. A replica with ANY different configuration simply
+  misses — there is no way to import a stale program under the wrong key.
+- **Never trusted blindly.** This container's jaxlib 0.4.36 mis-executes
+  *deserialized* executables on CPU under buffer donation (found by the
+  PR 2 chaos drills; ROBUSTNESS.md) — the failure mode is silently wrong
+  numbers, not an error. Every entry therefore stores a probe
+  expectation (deterministic canonical weights + probe batch → logits,
+  computed by the exporting process's freshly compiled program), and the
+  engine verifies each import bit-identically against it — plus one
+  bucket against a freshly compiled reference (engine-side). A refuted
+  entry is marked **poisoned** in its sidecar and skipped forever after;
+  the engine falls back to compiling.
+- **Atomic publication, v2 discipline.** Entries are published with the
+  checkpoint layer's fsync'd tmp+rename writes and carry a CRC32/size
+  manifest in a JSON sidecar — a torn entry (kill mid-export) fails the
+  manifest and reads as a miss, never as garbage handed to the XLA
+  deserializer. (The entry payload is a pickle of the serialized
+  executable + its pytree defs; the cache dir is operator-local state
+  with the same trust level as jax's own persistent compile cache.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+from pytorch_cifar_tpu.train.checkpoint import (
+    _atomic_write,
+    payload_manifest,
+    verify_checkpoint_payload,
+)
+
+log = logging.getLogger(__name__)
+
+CACHE_VERSION = 1
+
+
+def _load_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def fingerprint(key_fields: dict) -> str:
+    """Deterministic digest over the executable-identity fields."""
+    blob = json.dumps(key_fields, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def entry_name(model_name: str, bucket: int, digest: str) -> str:
+    # model + bucket stay human-readable in the filename for operability
+    # (ls the cache dir and see what is in it); the digest carries the
+    # full identity
+    safe = "".join(c if c.isalnum() else "-" for c in model_name)
+    return f"{safe}_b{int(bucket)}_{digest[:16]}.aotx"
+
+
+def entry_paths(cache_dir: str, name: str):
+    path = os.path.join(cache_dir, name)
+    return path, path + ".json"
+
+
+def export_entry(
+    cache_dir: str,
+    name: str,
+    compiled,
+    key_fields: dict,
+    probe_logits: np.ndarray,
+) -> Optional[str]:
+    """Serialize ``compiled`` + its probe expectation into the cache.
+    Returns the entry path, or None when this executable cannot be
+    serialized on this backend (logged; the cache is best-effort — a
+    failed export never fails the warmup that produced the program)."""
+    from jax.experimental.serialize_executable import serialize
+
+    _, meta_p = entry_paths(cache_dir, name)
+    existing = _load_json(meta_p)
+    if existing and existing.get("poisoned"):
+        # the tombstone outlives the entry: re-exporting would just
+        # restart the import -> refute -> poison cycle on a platform
+        # whose deserializer is the broken part
+        log.warning(
+            "AOT cache entry %s stays poisoned — not re-exporting", name
+        )
+        return None
+    try:
+        blob, in_tree, out_tree = serialize(compiled)
+        payload = pickle.dumps(
+            {
+                "version": CACHE_VERSION,
+                "key": key_fields,
+                "blob": blob,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+                "probe_logits": np.asarray(probe_logits),
+            }
+        )
+    except Exception as e:
+        log.warning("AOT cache export skipped for %s: %s", name, e)
+        return None
+    os.makedirs(cache_dir, exist_ok=True)
+    path, meta = entry_paths(cache_dir, name)
+    _atomic_write(path, payload)
+    # sidecar LAST (v2 write-order discipline): a verified pair is always
+    # from a single publish
+    _atomic_write(
+        meta,
+        json.dumps(
+            {
+                "manifest": payload_manifest(payload),
+                "key": key_fields,
+                "poisoned": False,
+            }
+        ).encode(),
+    )
+    return path
+
+
+def load_entry(cache_dir: str, name: str, key_fields: dict) -> Optional[dict]:
+    """Read + verify one cache entry. None on ANY problem (missing,
+    poisoned, torn, key mismatch, undeserializable) — a miss, never an
+    error: the caller compiles instead."""
+    path, meta_p = entry_paths(cache_dir, name)
+    try:
+        with open(meta_p) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if meta.get("poisoned"):
+        log.warning(
+            "AOT cache entry %s is poisoned (a previous import was "
+            "refuted by its probe) — compiling instead", name
+        )
+        return None
+    try:
+        with open(path, "rb") as f:
+            payload = f.read()
+        verify_checkpoint_payload(payload, meta, path)
+        entry = pickle.loads(payload)
+    except Exception as e:
+        log.warning("AOT cache entry %s unreadable (%s) — miss", name, e)
+        return None
+    if entry.get("version") != CACHE_VERSION or entry.get("key") != key_fields:
+        return None
+    return entry
+
+
+def poison_entry(cache_dir: str, name: str, reason: str) -> None:
+    """Mark an entry as refuted-by-probe so no later import trusts it."""
+    _, meta_p = entry_paths(cache_dir, name)
+    try:
+        with open(meta_p) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        meta = {}
+    meta["poisoned"] = True
+    meta["poison_reason"] = reason
+    _atomic_write(meta_p, json.dumps(meta).encode())
+    log.error("AOT cache entry %s POISONED: %s", name, reason)
+
+
+def deserialize_entry(entry: dict) -> Any:
+    """The loaded executable of a verified cache entry (may still raise —
+    the caller treats that as a miss)."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    return deserialize_and_load(
+        entry["blob"], entry["in_tree"], entry["out_tree"]
+    )

@@ -87,14 +87,24 @@ def load_checkpoint_trees(
         CheckpointCorrupt,
         best_checkpoint_order,
         meta_path,
-        verify_checkpoint_payload,
+        read_verified_payload,
     )
+
+    def _sidecar(dirpath, name):
+        try:
+            with open(meta_path(dirpath, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
 
     path = ckpt
     if os.path.isdir(path):
         for name in best_checkpoint_order(path):
             p = os.path.join(path, name)
-            if os.path.isfile(p):
+            # a format-v3 (sharded) checkpoint has no single payload
+            # file — its commit-marker sidecar listing the shards IS the
+            # candidate (ROBUSTNESS.md)
+            if os.path.isfile(p) or "shards" in _sidecar(path, name):
                 path = p
                 break
         else:
@@ -130,22 +140,19 @@ def load_checkpoint_trees(
 
     from flax import serialization
 
-    with open(path, "rb") as f:
-        payload = f.read()
     # the canonical sidecar rule (checkpoint.meta_path): <stem>.json next
     # to the msgpack
-    sidecar = meta_path(os.path.dirname(path) or ".", os.path.basename(path))
-    try:
-        with open(sidecar) as f:
-            meta = json.load(f)
-    except (OSError, ValueError):
-        meta = {}
-    # integrity gate (format v2, ROBUSTNESS.md): a truncated payload, a
-    # bit-flipped byte, or a payload/sidecar pair from two different
-    # publishes raises CheckpointCorrupt HERE — before any bytes reach the
-    # engine — instead of failing deep inside msgpack or silently serving
-    # wrong weights. v1 sidecars (no manifest) pass with a warning.
-    verify_checkpoint_payload(payload, meta, path)
+    meta = _sidecar(os.path.dirname(path) or ".", os.path.basename(path))
+    # integrity gate (formats v2/v3, ROBUSTNESS.md): a truncated payload,
+    # a bit-flipped byte, a missing/corrupt shard of a sharded publish,
+    # or a payload/sidecar pair from two different publishes raises
+    # CheckpointCorrupt HERE — before any bytes reach the engine —
+    # instead of failing deep inside msgpack or silently serving wrong
+    # weights. v3 candidates reassemble from their committed shards; v1
+    # sidecars (no manifest) pass with a warning.
+    payload = read_verified_payload(
+        os.path.dirname(path) or ".", os.path.basename(path), meta
+    )
     try:
         tree = serialization.msgpack_restore(payload)
     except Exception as e:
@@ -180,6 +187,7 @@ class InferenceEngine:
         warmup: bool = True,
         registry=None,
         mesh=None,
+        aot_cache_dir: Optional[str] = None,
     ):
         import jax.numpy as jnp
 
@@ -245,6 +253,7 @@ class InferenceEngine:
         )
         mean = CIFAR10_MEAN if mean is None else tuple(mean)
         std = CIFAR10_STD if std is None else tuple(std)
+        self._norm_mean, self._norm_std = mean, std  # cache-key identity
         # dtype=None -> fp32 module params/compute (the zoo convention);
         # bf16 modules match the trainer's amp policy
         model = create_model(
@@ -272,6 +281,14 @@ class InferenceEngine:
         self._swap_lock = threading.Lock()
         self.compile_count = 0  # bucket compiles only (see warmup)
         self.version = 0  # bumped by every swap_weights
+        # AOT executable cache (serve/aot_cache.py, SERVING.md): warmup
+        # imports previously exported bucket programs from this dir
+        # instead of recompiling — verified by probe, never trusted
+        # blindly — and exports whatever it had to compile. None = off.
+        self.aot_cache_dir = aot_cache_dir
+        self.aot_cache_hits = 0
+        self.aot_cache_misses = 0
+        self.cold_start_s = 0.0  # wall time of the last warmup()
         # observability (obs/): device-time histogram per executable call
         # — against the batcher's admission-to-completion latency this
         # splits queue wait from device time. Optional: None costs one
@@ -354,38 +371,243 @@ class InferenceEngine:
 
     # -- compilation ---------------------------------------------------
 
-    def warmup(self) -> None:
-        """AOT-compile every bucket program (idempotent). After this, no
-        ``predict`` can compile anything: each bucket call goes through
-        its pre-built executable, which raises on any other shape."""
+    def _compile_bucket(self, b: int, count: bool = True):
+        """One bucket's AOT compile. ``count=False`` builds a
+        verification-only reference (AOT-cache probe check) that — like
+        ``direct_forward``'s compiles — is deliberately excluded from
+        ``compile_count``: it is verification overhead, not the serving
+        path."""
         import jax
         import jax.numpy as jnp
 
         params, stats = self._weights
-        for b in self.buckets:
-            if b in self._compiled:
-                continue
-            x = jnp.zeros((b, *self.image_shape), jnp.uint8)
-            if self._batch_sharding is not None:
-                # batch axis over the data mesh; weights are already
-                # committed replicated, so jit infers their shardings and
-                # the per-row program contains NO collectives (eval
-                # forward is row-independent — out stays batch-sharded)
-                x = jax.device_put(x, self._batch_sharding)
-            jitted = (
-                jax.jit(self._fwd, out_shardings=self._batch_sharding)
-                if self._batch_sharding is not None
-                else jax.jit(self._fwd)
-            )
-            with trace.span(
-                "serve/compile_bucket", bucket=b, devices=self.n_devices
-            ):
-                self._compiled[b] = (
-                    jitted.lower(params, stats, x).compile()
-                )
+        x = jnp.zeros((b, *self.image_shape), jnp.uint8)
+        if self._batch_sharding is not None:
+            # batch axis over the data mesh; weights are already
+            # committed replicated, so jit infers their shardings and
+            # the per-row program contains NO collectives (eval
+            # forward is row-independent — out stays batch-sharded)
+            x = jax.device_put(x, self._batch_sharding)
+        jitted = (
+            jax.jit(self._fwd, out_shardings=self._batch_sharding)
+            if self._batch_sharding is not None
+            else jax.jit(self._fwd)
+        )
+        with trace.span(
+            "serve/compile_bucket", bucket=b, devices=self.n_devices,
+            counted=count,
+        ):
+            compiled = jitted.lower(params, stats, x).compile()
+        if count:
             self.compile_count += 1
             if self._obs is not None:
                 self._obs.counter("serve.compiles").inc()
+        return compiled
+
+    # -- AOT executable cache (serve/aot_cache.py) ---------------------
+
+    def _cache_key_fields(self, b: int) -> dict:
+        """Everything that invalidates a bucket executable — a different
+        value in ANY field yields a different cache entry name."""
+        import jax
+        import jaxlib
+
+        return {
+            "model": self.model_name,
+            "bucket": int(b),
+            "num_classes": int(self.num_classes),
+            "image_shape": list(self.image_shape),
+            "compute_dtype": str(np.dtype(self.compute_dtype))
+            if self.compute_dtype != jax.numpy.bfloat16
+            else "bfloat16",
+            "mean": [float(v) for v in self._norm_mean],
+            "std": [float(v) for v in self._norm_std],
+            "n_devices": int(self.n_devices),
+            "mesh": list(self.mesh.devices.shape) if self.mesh is not None
+            else None,
+            "platform": jax.devices()[0].platform,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+        }
+
+    def _probe_batch(self, b: int) -> np.ndarray:
+        rs = np.random.RandomState(1234 + int(b))
+        return rs.randint(
+            0, 256, size=(b, *self.image_shape)
+        ).astype(np.uint8)
+
+    def _probe_weights(self):
+        """Deterministic canonical weight trees at the engine's exact
+        avals. Probe expectations must NOT depend on the served
+        checkpoint — hot reload swaps weights without recompiling, and
+        two replicas loading different checkpoints must share cache
+        entries — so probes run under these fills instead. Params get
+        fan-in-scaled zero-mean values (activations stay O(1) at any
+        depth: an overflowed probe would bit-compare inf==inf trivially,
+        a NaN would defeat it outright), batch_stats get positive values
+        (BN variances must be valid)."""
+        import jax
+        import jax.numpy as jnp
+
+        rs = np.random.RandomState(0xA07)
+
+        def _dtype(a):
+            return getattr(a, "dtype", None) or np.asarray(a).dtype
+
+        def fill_param(a):
+            shape = np.shape(a)
+            fan_in = int(np.prod(shape[:-1])) if len(shape) >= 2 else 1
+            arr = rs.standard_normal(shape) / np.sqrt(max(fan_in, 1))
+            return jnp.asarray(arr, dtype=_dtype(a))
+
+        def fill_stat(a):
+            return jnp.asarray(
+                rs.uniform(0.25, 1.0, size=np.shape(a)), dtype=_dtype(a)
+            )
+
+        params, stats = self._weights
+        tree = (
+            jax.tree_util.tree_map(fill_param, params),
+            jax.tree_util.tree_map(fill_stat, stats),
+        )
+        if self.mesh is not None:
+            from pytorch_cifar_tpu.parallel import replicate
+
+            tree = replicate(jax.device_get(tree), self.mesh)
+        return tree
+
+    def _run_probe(self, exe, weights, x: np.ndarray) -> np.ndarray:
+        import jax
+
+        p, s = weights
+        return np.asarray(jax.device_get(exe(p, s, self._put_batch(x))))
+
+    def _import_cached(self, cache_dir: str) -> dict:
+        """Verified executables from the AOT cache, keyed by bucket.
+
+        Verification is two-layered (this container's jaxlib 0.4.36
+        mis-executes deserialized executables on CPU under donation —
+        ROBUSTNESS.md — so imports are never trusted blindly): every
+        entry's probe batch must reproduce its export-time expectation
+        bit-for-bit under canonical weights, and ONE bucket (the smallest
+        imported) is additionally checked against a freshly compiled
+        reference. Any refuted entry is marked poisoned and the whole
+        cache load is dropped — the engine compiles instead."""
+        from pytorch_cifar_tpu.serve import aot_cache
+
+        def miss(n: int = 1):
+            self.aot_cache_misses += n
+            if self._obs is not None:
+                self._obs.counter("serve.aot_cache_misses").inc(n)
+
+        candidates: dict = {}
+        probe_out: dict = {}
+        names: dict = {}
+        probe_weights = None
+        for b in self.buckets:
+            if b in self._compiled:
+                continue
+            key = self._cache_key_fields(b)
+            name = aot_cache.entry_name(
+                self.model_name, b, aot_cache.fingerprint(key)
+            )
+            entry = aot_cache.load_entry(cache_dir, name, key)
+            if entry is None:
+                miss()
+                continue
+            try:
+                exe = aot_cache.deserialize_entry(entry)
+            except Exception as e:
+                log.warning(
+                    "AOT cache entry %s failed to deserialize (%s) — "
+                    "compiling", name, e,
+                )
+                miss()
+                continue
+            if probe_weights is None:
+                probe_weights = self._probe_weights()
+            got = self._run_probe(exe, probe_weights, self._probe_batch(b))
+            if not np.array_equal(got, np.asarray(entry["probe_logits"])):
+                aot_cache.poison_entry(
+                    cache_dir, name,
+                    "probe logits differ from export-time expectation",
+                )
+                miss()
+                continue
+            candidates[b] = exe
+            probe_out[b] = got
+            names[b] = name
+        if not candidates:
+            return {}
+        b0 = min(candidates)
+        ref = self._compile_bucket(b0, count=False)
+        ref_logits = self._run_probe(
+            ref, probe_weights, self._probe_batch(b0)
+        )
+        if not np.array_equal(ref_logits, probe_out[b0]):
+            aot_cache.poison_entry(
+                cache_dir, names[b0],
+                "deserialized executable diverges from a freshly "
+                "compiled reference (jaxlib deserialization bug class — "
+                "ROBUSTNESS.md)",
+            )
+            # one refuted import invalidates the whole load: the stored
+            # expectations came from the same exporter
+            miss(len(candidates))
+            return {}
+        self.aot_cache_hits += len(candidates)
+        if self._obs is not None:
+            self._obs.counter("serve.aot_cache_hits").inc(len(candidates))
+        return candidates
+
+    def warmup(self, cache_dir: Optional[str] = None) -> None:
+        """AOT-compile every bucket program (idempotent). After this, no
+        ``predict`` can compile anything: each bucket call goes through
+        its pre-built executable, which raises on any other shape.
+
+        With an AOT cache (``cache_dir`` or the constructor's
+        ``aot_cache_dir``), previously exported bucket programs are
+        imported instead of recompiled — a warm replica cold-starts in
+        load time with ``compile_count == 0`` — and whatever had to be
+        compiled is exported for the next replica. Cache entries are
+        verified by probe before use (see :meth:`_import_cached`);
+        multi-process serving skips the cache (executables embed the
+        local process topology)."""
+        import jax
+
+        t0 = time.perf_counter()
+        cache_dir = cache_dir if cache_dir is not None else self.aot_cache_dir
+        use_cache = bool(cache_dir) and jax.process_count() == 1
+        imported = self._import_cached(cache_dir) if use_cache else {}
+        probe_weights = None
+        for b in self.buckets:
+            if b in self._compiled:
+                continue
+            if b in imported:
+                self._compiled[b] = imported[b]
+                continue
+            self._compiled[b] = self._compile_bucket(b)
+            if use_cache:
+                from pytorch_cifar_tpu.serve import aot_cache
+
+                if probe_weights is None:
+                    probe_weights = self._probe_weights()
+                key = self._cache_key_fields(b)
+                aot_cache.export_entry(
+                    cache_dir,
+                    aot_cache.entry_name(
+                        self.model_name, b, aot_cache.fingerprint(key)
+                    ),
+                    self._compiled[b],
+                    key,
+                    self._run_probe(
+                        self._compiled[b], probe_weights,
+                        self._probe_batch(b),
+                    ),
+                )
+        self.cold_start_s = time.perf_counter() - t0
+        if self._obs is not None:
+            self._obs.gauge("serve.cold_start_s").set(self.cold_start_s)
 
     def bucket_for(self, n: int) -> int:
         """Smallest bucket >= n, or the largest bucket (callers chunk).

@@ -38,7 +38,11 @@ import os
 import threading
 from typing import Optional
 
-from pytorch_cifar_tpu.train.checkpoint import CKPT_NAME, CheckpointCorrupt
+from pytorch_cifar_tpu.train.checkpoint import (
+    CKPT_NAME,
+    CheckpointCorrupt,
+    meta_path,
+)
 
 log = logging.getLogger(__name__)
 
@@ -87,13 +91,20 @@ class CheckpointWatcher:
         return os.path.join(self.ckpt_dir, self.name)
 
     def _signature(self):
-        """Identity of the current checkpoint file. The save path is
-        atomic tmp+rename, so a new checkpoint is a new inode — (ino,
-        mtime_ns, size) changes on every publish and never mid-write."""
+        """Identity of the current checkpoint publication. The save path
+        is atomic tmp+rename, so a new checkpoint is a new inode — (ino,
+        mtime_ns, size) changes on every publish and never mid-write.
+        A sharded (format v3) checkpoint has no single payload file; its
+        commit-marker sidecar — written LAST by the publisher — is the
+        publication identity instead, which also means shards landing
+        before the commit can never trigger a premature reload."""
         try:
             st = os.stat(self._path())
         except OSError:
-            return None
+            try:
+                st = os.stat(meta_path(self.ckpt_dir, self.name))
+            except OSError:
+                return None
         return (st.st_ino, st.st_mtime_ns, st.st_size)
 
     def poll_once(self) -> bool:

@@ -6,17 +6,40 @@ buffers), step, epoch, and best_acc all round-trip, so a resumed run
 continues the exact momentum + LR trajectory (the reference restarts both,
 SURVEY.md §3.4). Same best-accuracy gating semantics (main.py:136-148).
 
-Format v2 (ROBUSTNESS.md): flax msgpack of the array pytree + a JSON
-sidecar carrying the scalars AND a payload manifest (CRC32 + size). Writes
-are atomic and durable — tmp file fsync'd before the rename, directory
-fsync'd after — and process-0-only under multi-host SPMD (rank-0 gating
-parity, main_dist.py:243). Restore verifies the manifest and falls back
-through the candidate order on ANY corruption (truncated payload, bad
-msgpack, checksum mismatch), not just a missing file; under multi-host the
-winning candidate is process 0's decision, broadcast to every host, so no
-host can diverge. v1 checkpoints (no manifest) still restore, with a
-logged warning. ``keep_last_n`` keeps a rolling history of prior
-checkpoint versions as extra fallback candidates.
+Formats (ROBUSTNESS.md):
+
+- **v2** (single-host): flax msgpack of the array pytree + a JSON sidecar
+  carrying the scalars AND a payload manifest (CRC32 + size). Writes are
+  atomic and durable — tmp file fsync'd before the rename, directory
+  fsync'd after.
+- **v3** (sharded, multihost default): the SAME msgpack payload split
+  into N contiguous byte ranges, one per process — each host writes only
+  its own shard (plus a shard sidecar carrying that range's manifest),
+  and process 0 publishes the commit marker LAST: the main sidecar,
+  which lists every shard with its CRC32/size plus the whole-payload
+  manifest. A reader trusts nothing that the commit marker does not
+  describe, so an interrupted sharded publish is simply invisible (the
+  old commit marker still describes the old complete set). Byte-range
+  sharding (rather than pytree-partition sharding) is deliberate: the
+  state is replicated, so every host already holds the full serialized
+  bytes, the reassembled payload is bit-identical to a v2 save of the
+  same state, and restore reuses the exact v2 deserialization path.
+
+Saves can be **asynchronous**: ``save_checkpoint(..., writer=...)`` does
+only the device_get snapshot on the calling thread and hands
+serialization + CRC + the fsync'd tmp+rename commit to an
+:class:`AsyncCheckpointWriter` background thread — bounded to ONE pending
+save (a newer save supersedes a queued one), with writer errors re-raised
+on the next submit/flush and a clean join on shutdown.
+
+Restore verifies the manifest(s) and falls back through the candidate
+order on ANY corruption (truncated payload, bad msgpack, checksum
+mismatch, missing/corrupt shard, absent commit marker), not just a
+missing file; under multi-host the winning candidate is process 0's
+decision, broadcast to every host, so no host can diverge. v1 checkpoints
+(no manifest) still restore, with a logged warning. ``keep_last_n`` keeps
+a rolling history of prior checkpoint versions as extra fallback
+candidates.
 """
 
 from __future__ import annotations
@@ -26,14 +49,16 @@ import json
 import logging
 import os
 import re
+import threading
 import time
 import zlib
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
+from pytorch_cifar_tpu import faults
 from pytorch_cifar_tpu.obs import trace
 from pytorch_cifar_tpu.train.state import TrainState
 
@@ -43,11 +68,20 @@ CKPT_NAME = "ckpt.msgpack"   # best-accuracy checkpoint (reference semantics)
 LAST_NAME = "last.msgpack"   # preemption save: exact latest state
 
 MANIFEST_FORMAT = 2
+SHARDED_FORMAT = 3
+
+# sharded-publish barrier: how long process 0 waits for every peer's shard
+# (and how often it re-polls the shared filesystem) before the commit
+# marker may be written. Generous: a peer paying a slow device_get or a
+# laggy NFS close must not fail the whole publish.
+_SHARD_BARRIER_TIMEOUT_S = 120.0
+_SHARD_BARRIER_POLL_S = 0.05
 
 
 class CheckpointCorrupt(RuntimeError):
-    """A checkpoint payload failed verification (checksum/size mismatch or
-    undeserializable bytes). Restore falls back; serving skips the swap."""
+    """A checkpoint payload failed verification (checksum/size mismatch,
+    missing/corrupt shard, or undeserializable bytes). Restore falls
+    back; serving skips the swap."""
 
 
 def meta_path(output_dir: str, name: str) -> str:
@@ -55,9 +89,19 @@ def meta_path(output_dir: str, name: str) -> str:
     return os.path.join(output_dir, os.path.splitext(name)[0] + ".json")
 
 
+def shard_name(name: str, index: int, num_shards: int) -> str:
+    """On-disk name of byte-range shard ``index`` of ``name`` (format v3).
+
+    The ``-of-N`` suffix is part of the identity: a save from a different
+    process count can never be confused with (or partially overwrite) the
+    current one, because every shard name changes with N."""
+    stem = os.path.splitext(name)[0]
+    return f"{stem}.shard{int(index):05d}-of-{int(num_shards):05d}.msgpack"
+
+
 def payload_manifest(payload: bytes) -> dict:
     """The sidecar manifest entry that lets any reader verify the payload
-    without deserializing it (format v2)."""
+    without deserializing it (format v2; v3 reuses it per shard)."""
     return {
         "format": MANIFEST_FORMAT,
         "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
@@ -121,6 +165,16 @@ def _atomic_write(path: str, data: bytes) -> None:
     _fsync_dir(os.path.dirname(path))
 
 
+def _chaos_stall() -> None:
+    """Chaos injection point (inert unless armed): sleep between a
+    payload/shard write and its sidecar/commit-marker write, so the kill
+    drill (tools/chaos_run.py --mode ckpt) can land a SIGKILL
+    deterministically inside the torn-pair window."""
+    ms = faults.get("ckpt_write_stall")
+    if ms:
+        time.sleep(float(ms) / 1e3)
+
+
 # -- rolling history -----------------------------------------------------
 
 def _history_stem(name: str) -> str:
@@ -133,7 +187,9 @@ def _history_name(name: str, epoch: int) -> str:
 
 def history_names(output_dir: str, name: str):
     """Rolling-history checkpoint names for ``name``, newest epoch first —
-    the extra fallback candidates behind the primary file."""
+    the extra fallback candidates behind the primary file. Shard files
+    (``<stem>-eNNNNN.shard*``) are not history entries themselves: they
+    belong to the v3 history commit marker that lists them."""
     pat = re.compile(
         re.escape(_history_stem(name)) + r"-e(\d+)\.msgpack$"
     )
@@ -144,7 +200,40 @@ def history_names(output_dir: str, name: str):
         m = pat.search(os.path.basename(path))
         if m:
             found.append((int(m.group(1)), os.path.basename(path)))
-    return [n for _, n in sorted(found, reverse=True)]
+    # v3 history entries have no <hist>.msgpack payload file — only the
+    # commit sidecar and shards — so also scan the sidecars
+    spat = re.compile(re.escape(_history_stem(name)) + r"-e(\d+)\.json$")
+    for path in glob.glob(
+        os.path.join(output_dir, _history_stem(name) + "-e*.json")
+    ):
+        m = spat.search(os.path.basename(path))
+        if m:
+            entry = (int(m.group(1)), _history_name(name, int(m.group(1))))
+            if entry not in found:
+                found.append(entry)
+    return [n for _, n in sorted(set(found), reverse=True)]
+
+
+def _remove_candidate_files(output_dir: str, name: str) -> None:
+    """Delete every file belonging to checkpoint candidate ``name``:
+    payload, sidecar, and any v3 shards + shard sidecars."""
+    stem = os.path.splitext(name)[0]
+    targets = [os.path.join(output_dir, name), meta_path(output_dir, name)]
+    for sp in glob.glob(
+        os.path.join(output_dir, stem + ".shard*-of-*.msgpack")
+    ):
+        targets.append(sp)
+        targets.append(meta_path(output_dir, os.path.basename(sp)))
+    for p in targets:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def _prune_history(output_dir: str, name: str, keep_last_n: int) -> None:
+    for stale in history_names(output_dir, name)[keep_last_n:]:
+        _remove_candidate_files(output_dir, stale)
 
 
 def _update_history(
@@ -161,18 +250,284 @@ def _update_history(
         meta_path(output_dir, hname),
         json.dumps(meta).encode(),
     )
-    for stale in history_names(output_dir, name)[keep_last_n:]:
-        for p in (
-            os.path.join(output_dir, stale),
-            meta_path(output_dir, stale),
-        ):
+    _prune_history(output_dir, name, keep_last_n)
+
+
+# -- async writer --------------------------------------------------------
+
+class AsyncCheckpointWriter:
+    """Background commit thread for :func:`save_checkpoint`.
+
+    Contract (ROBUSTNESS.md "async writer"):
+
+    - **Bounded to one pending save.** The queue holds at most one
+      not-yet-started commit; submitting while one is queued replaces it
+      (the newer snapshot supersedes — only the newest state matters for
+      durability, and an unbounded queue would let a fast improvement
+      streak pile up minutes of serialized writes).
+    - **Errors re-raise on the next trainer interaction.** A failed
+      background commit (disk full, dir deleted, barrier timeout) is
+      stored and re-raised by the next :meth:`submit`, :meth:`flush`, or
+      :meth:`close` — never silently dropped, never a phantom checkpoint.
+    - **Clean join on shutdown.** :meth:`close` drains whatever is
+      pending, joins the thread, and re-raises any stored error. The
+      thread is started lazily on first submit, so a writer that never
+      sees a save costs nothing.
+
+    Every cross-thread attribute is mutated only under ``self._cond``
+    (graftcheck ``unlocked-shared-mutation`` passes by construction).
+    """
+
+    def __init__(self, registry=None, name: str = "ckpt-writer"):
+        self._cond = threading.Condition()
+        self._pending: Optional[Callable[[], Any]] = None
+        self._busy = False
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._obs = registry
+        self._name = name
+
+    def _publish_depth_locked(self) -> None:
+        if self._obs is not None:
+            self._obs.gauge("checkpoint.pending_saves").set(
+                (1 if self._pending is not None else 0)
+                + (1 if self._busy else 0)
+            )
+
+    def _raise_pending_error_locked(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def submit(self, job: Callable[[], Any]) -> None:
+        """Queue ``job`` (a commit closure) for the background thread.
+        Replaces any still-queued older job; re-raises a stored error
+        from an earlier failed commit."""
+        with self._cond:
+            self._raise_pending_error_locked()
+            if self._pending is not None:
+                if self._obs is not None:
+                    self._obs.counter("checkpoint.superseded_saves").inc()
+            self._pending = job
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True
+                )
+                self._thread.start()
+            self._publish_depth_locked()
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stopping:
+                    self._cond.wait()
+                if self._pending is None:
+                    return
+                job = self._pending
+                self._pending = None
+                self._busy = True
+                self._publish_depth_locked()
+            t0 = time.perf_counter()
+            err = None
             try:
-                os.remove(p)
-            except OSError:
-                pass
+                job()
+            except BaseException as e:  # stored, re-raised on interaction
+                err = e
+            if self._obs is not None:
+                self._obs.histogram("checkpoint.writer_ms").observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
+            with self._cond:
+                if err is not None and self._error is None:
+                    self._error = err
+                self._busy = False
+                self._publish_depth_locked()
+                self._cond.notify_all()
+
+    def flush(self) -> None:
+        """Block until every submitted commit is durably on disk;
+        re-raise any background error."""
+        with self._cond:
+            while self._pending is not None or self._busy:
+                self._cond.wait()
+            self._raise_pending_error_locked()
+
+    def close(self) -> None:
+        """Drain pending work, join the thread, re-raise any error. The
+        writer is reusable afterwards (a later submit restarts it)."""
+        with self._cond:
+            self._stopping = True
+            t = self._thread
+            self._thread = None
+            self._cond.notify_all()
+        if t is not None:
+            t.join()
+        with self._cond:
+            self._stopping = False
+            self._raise_pending_error_locked()
 
 
 # -- save ----------------------------------------------------------------
+
+def _write_unsharded(
+    output_dir: str, name: str, payload: bytes, epoch: int,
+    best_acc: float, keep_last_n: int,
+) -> str:
+    """Format v2 commit: payload first, sidecar (carrying the payload's
+    manifest) second — a reader that verifies the manifest therefore
+    never trusts a payload/sidecar pairing from two different
+    publishes (serve/reload.py gates its hot swap on exactly this)."""
+    path = os.path.join(output_dir, name)
+    with trace.span("checkpoint/write", bytes=len(payload)):
+        _atomic_write(path, payload)
+        _chaos_stall()
+        meta = {
+            "epoch": int(epoch),
+            "best_acc": float(best_acc),
+            "manifest": payload_manifest(payload),
+        }
+        _atomic_write(
+            meta_path(output_dir, name), json.dumps(meta).encode()
+        )
+        if keep_last_n > 0:
+            _update_history(
+                output_dir, name, epoch, payload, meta, keep_last_n
+            )
+    return path
+
+
+def _await_shard(
+    output_dir: str, sname: str, epoch: int, deadline: float
+) -> dict:
+    """Wait until shard ``sname`` of THIS publish is durably on disk:
+    its sidecar's epoch matches and the shard bytes verify against the
+    sidecar manifest. Returns the shard manifest. The epoch check is what
+    keeps a stale same-name shard from a previous publish out of the
+    commit; atomic renames mean no torn intermediate is ever visible."""
+    spath = os.path.join(output_dir, sname)
+    while True:
+        try:
+            with open(meta_path(output_dir, sname)) as f:
+                smeta = json.load(f)
+            if (
+                int(smeta.get("epoch", -2)) == int(epoch)
+                and smeta.get("manifest")
+            ):
+                with open(spath, "rb") as f:
+                    blob = f.read()
+                verify_checkpoint_payload(blob, smeta, spath)
+                return smeta["manifest"]
+        except (OSError, ValueError, CheckpointCorrupt):
+            pass
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"sharded checkpoint barrier timed out waiting for "
+                f"{sname} (epoch {epoch}) — peer process dead or "
+                f"checkpoint dir not shared?"
+            )
+        time.sleep(_SHARD_BARRIER_POLL_S)
+
+
+def _write_sharded(
+    output_dir: str, name: str, payload: bytes, epoch: int,
+    best_acc: float, keep_last_n: int, num_shards: int,
+    shard_index: Optional[int],
+) -> Optional[str]:
+    """Format v3 commit (orbax-style, ROBUSTNESS.md): every process
+    writes its own byte-range shard + shard sidecar; process 0 waits for
+    the full set (filesystem barrier — no collectives, so the writer
+    thread stays gloo-safe) and then publishes the commit marker LAST.
+    ``shard_index`` None = this process writes every shard (single-process
+    sharded save, used by tests and tools)."""
+    n = int(num_shards)
+    chunk = max(1, -(-len(payload) // n))
+    names = [shard_name(name, k, n) for k in range(n)]
+    hname = _history_name(name, epoch) if keep_last_n > 0 else None
+    mine = range(n) if shard_index is None else (int(shard_index),)
+    for k in mine:
+        blob = payload[k * chunk:(k + 1) * chunk]
+        smeta = {"epoch": int(epoch), "manifest": payload_manifest(blob)}
+        _atomic_write(os.path.join(output_dir, names[k]), blob)
+        _chaos_stall()
+        _atomic_write(
+            meta_path(output_dir, names[k]), json.dumps(smeta).encode()
+        )
+        if hname is not None:
+            hs = shard_name(hname, k, n)
+            _atomic_write(os.path.join(output_dir, hs), blob)
+            _atomic_write(
+                meta_path(output_dir, hs), json.dumps(smeta).encode()
+            )
+    if shard_index not in (None, 0):
+        return None  # peers are done; process 0 owns the commit marker
+    deadline = time.monotonic() + _SHARD_BARRIER_TIMEOUT_S
+    manifests = []
+    for k in range(n):
+        manifests.append(_await_shard(output_dir, names[k], epoch, deadline))
+        if hname is not None:
+            _await_shard(
+                output_dir, shard_name(hname, k, n), epoch, deadline
+            )
+    meta = {
+        "format": SHARDED_FORMAT,
+        "epoch": int(epoch),
+        "best_acc": float(best_acc),
+        "total": payload_manifest(payload),
+        "shards": [
+            {"name": nm, "crc32": mf["crc32"], "size": mf["size"]}
+            for nm, mf in zip(names, manifests)
+        ],
+    }
+    _chaos_stall()
+    _atomic_write(meta_path(output_dir, name), json.dumps(meta).encode())
+    if hname is not None:
+        hmeta = dict(meta)
+        hmeta["shards"] = [
+            {
+                "name": shard_name(hname, k, n),
+                "crc32": mf["crc32"],
+                "size": mf["size"],
+            }
+            for k, mf in enumerate(manifests)
+        ]
+        _atomic_write(
+            meta_path(output_dir, hname), json.dumps(hmeta).encode()
+        )
+        _prune_history(output_dir, name, keep_last_n)
+    return os.path.join(output_dir, name)
+
+
+def _commit_host_state(
+    output_dir: str, name: str, host_state, epoch: int, best_acc: float,
+    keep_last_n: int, registry, num_shards: int,
+    shard_index: Optional[int], t0: float,
+) -> Optional[str]:
+    """Serialize + CRC + fsync'd atomic publish of an already-fetched
+    host snapshot — the half of a save that runs on the writer thread
+    under ``--async_save on`` (and inline under sync)."""
+    payload = serialization.to_bytes(host_state)
+    if num_shards > 1:
+        with trace.span(
+            "checkpoint/write", bytes=len(payload), shards=num_shards
+        ):
+            path = _write_sharded(
+                output_dir, name, payload, epoch, best_acc, keep_last_n,
+                num_shards, shard_index,
+            )
+    else:
+        path = _write_unsharded(
+            output_dir, name, payload, epoch, best_acc, keep_last_n
+        )
+    if registry is not None and shard_index in (None, 0):
+        registry.counter("checkpoint.saves").inc()
+        registry.counter("checkpoint.saved_bytes").inc(len(payload))
+        registry.histogram("checkpoint.save_ms").observe(
+            (time.perf_counter() - t0) * 1e3
+        )
+    return path
+
 
 def save_checkpoint(
     output_dir: str,
@@ -182,25 +537,45 @@ def save_checkpoint(
     name: str = CKPT_NAME,
     keep_last_n: int = 0,
     registry=None,
+    writer: Optional[AsyncCheckpointWriter] = None,
+    num_shards: Optional[int] = None,
 ) -> Optional[str]:
-    """Write state to ``output_dir`` (process 0 only). Returns the path.
+    """Write state to ``output_dir``. Returns the primary path on the
+    committing process (process 0), None elsewhere.
 
-    Write order is part of the format: payload first, sidecar (carrying
-    the payload's manifest) second — a reader that verifies the manifest
-    therefore never trusts a payload/sidecar pairing from two different
-    publishes (serve/reload.py gates its hot swap on exactly this).
+    Single-host writes format v2 (process 0 only). Under multihost every
+    process participates in a format-v3 sharded publish: each host writes
+    its own byte-range shard and process 0 writes the commit marker last
+    (``_write_sharded``). ``num_shards`` > 1 forces a v3 layout from a
+    single process (tests/tools); under multihost it must equal the
+    process count.
 
-    ``registry`` (obs.MetricsRegistry, optional): records duration and
-    payload bytes — through a serialized host link the device_get below is
-    the dominant cost of a save, and without a number it gets blamed on
-    the training step it stalls (OBSERVABILITY.md)."""
-    if jax.process_index() != 0:
+    ``writer`` (:class:`AsyncCheckpointWriter`, optional): only the
+    device_get snapshot runs on the calling thread — serialization, CRC,
+    and the fsync'd commit move to the writer thread, so the trainer's
+    save stall shrinks to the snapshot cost. ``registry`` records
+    ``checkpoint.save_stall_ms`` (calling-thread blocked time) either
+    way; the commit half records saves/bytes/``save_ms`` on completion
+    and the writer records ``checkpoint.writer_ms`` (OBSERVABILITY.md).
+    """
+    pidx, pcount = jax.process_index(), jax.process_count()
+    n = int(num_shards) if num_shards else (pcount if pcount > 1 else 1)
+    if pcount > 1 and n > 1 and n != pcount:
+        raise ValueError(
+            f"num_shards={n} must equal the process count ({pcount}) "
+            "under multihost — each process writes exactly its own shard"
+        )
+    if n <= 1 and pidx != 0:
         return None
+    shard_index = pidx if (pcount > 1 and n > 1) else None
     t0 = time.perf_counter()
-    with trace.span("checkpoint/save", file=name, epoch=int(epoch)):
+    with trace.span(
+        "checkpoint/save", file=name, epoch=int(epoch), shards=n
+    ):
         os.makedirs(output_dir, exist_ok=True)
         # one logical copy on host; works for replicated or single-device
-        # state
+        # state. This is the fast on-thread snapshot: the state buffers
+        # are free to be donated/overwritten the moment it returns.
         with trace.span("checkpoint/device_get"):
             host_state = jax.device_get(
                 {
@@ -210,30 +585,22 @@ def save_checkpoint(
                     "step": state.step,
                 }
             )
-        payload = serialization.to_bytes(host_state)
-        path = os.path.join(output_dir, name)
-        with trace.span("checkpoint/write", bytes=len(payload)):
-            _atomic_write(path, payload)
 
-            meta = {
-                "epoch": int(epoch),
-                "best_acc": float(best_acc),
-                "manifest": payload_manifest(payload),
-            }
-            _atomic_write(
-                meta_path(output_dir, name), json.dumps(meta).encode()
+        def commit():
+            return _commit_host_state(
+                output_dir, name, host_state, epoch, best_acc,
+                keep_last_n, registry, n, shard_index, t0,
             )
-            if keep_last_n > 0:
-                _update_history(
-                    output_dir, name, epoch, payload, meta, keep_last_n
-                )
+
+        if writer is None:
+            commit()
+        else:
+            writer.submit(commit)
     if registry is not None:
-        registry.counter("checkpoint.saves").inc()
-        registry.counter("checkpoint.saved_bytes").inc(len(payload))
-        registry.histogram("checkpoint.save_ms").observe(
+        registry.histogram("checkpoint.save_stall_ms").observe(
             (time.perf_counter() - t0) * 1e3
         )
-    return path
+    return os.path.join(output_dir, name) if pidx == 0 else None
 
 
 def newest_checkpoint_order(output_dir: str):
@@ -267,40 +634,77 @@ def best_checkpoint_order(output_dir: str = None):
 
 
 def remove_stale_last(output_dir: str) -> None:
-    """Delete the preemption save (last.msgpack + sidecar) after a run
-    COMPLETES normally: a leftover one would make a routine relaunch with
-    --resume roll training back to the preemption point. Shared by
-    Trainer.fit and tools/accuracy_run.py so the rule cannot drift."""
+    """Delete the preemption save (last.msgpack + sidecar + any v3
+    shards) after a run COMPLETES normally: a leftover one would make a
+    routine relaunch with --resume roll training back to the preemption
+    point. Shared by Trainer.fit and tools/accuracy_run.py so the rule
+    cannot drift."""
     if jax.process_index() != 0 or not output_dir:
         return
     stale = [LAST_NAME] + history_names(output_dir, LAST_NAME)
     for name in stale:
-        for path in (
-            os.path.join(output_dir, name),
-            meta_path(output_dir, name),
-        ):
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+        _remove_candidate_files(output_dir, name)
 
 
 # -- restore -------------------------------------------------------------
+
+def _read_meta(output_dir: str, name: str) -> dict:
+    try:
+        with open(meta_path(output_dir, name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def read_verified_payload(
+    output_dir: str, name: str, meta: Optional[dict] = None
+) -> bytes:
+    """The verified msgpack payload of checkpoint candidate ``name`` —
+    reassembled from v3 shards when the sidecar is a sharded commit
+    marker, read + manifest-verified directly otherwise (v1/v2).
+
+    FileNotFoundError means "candidate absent" — including a v3 publish
+    whose commit marker was never written (torn shards are invisible
+    without it, by construction). CheckpointCorrupt means "candidate
+    exists but is unusable": truncated/mismatched payload, or a COMMITTED
+    shard that is missing or fails its CRC. Shared by restore and
+    serve's ``load_checkpoint_trees`` so the format rules cannot drift.
+    """
+    if meta is None:
+        meta = _read_meta(output_dir, name)
+    path = os.path.join(output_dir, name)
+    shards = (meta or {}).get("shards")
+    if shards:
+        parts = []
+        for s in shards:
+            sp = os.path.join(output_dir, s["name"])
+            try:
+                with open(sp, "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                raise CheckpointCorrupt(
+                    f"{path}: committed shard {s['name']} is missing ({e})"
+                ) from e
+            verify_checkpoint_payload(blob, {"manifest": s}, sp)
+            parts.append(blob)
+        payload = b"".join(parts)
+        total = meta.get("total")
+        if total:
+            verify_checkpoint_payload(payload, {"manifest": total}, path)
+        return payload
+    with open(path, "rb") as f:
+        payload = f.read()
+    verify_checkpoint_payload(payload, meta, path)
+    return payload
+
 
 def _read_verified(output_dir: str, name: str, target) -> Tuple[Any, int, float]:
     """Read + verify + deserialize one candidate. FileNotFoundError means
     "candidate absent" (silent skip); CheckpointCorrupt means "candidate
     exists but is unusable" (logged skip)."""
+    meta = _read_meta(output_dir, name)
+    payload = read_verified_payload(output_dir, name, meta)
     path = os.path.join(output_dir, name)
-    with open(path, "rb") as f:
-        payload = f.read()
-    meta: dict = {}
-    try:
-        with open(meta_path(output_dir, name)) as f:
-            meta = json.load(f)
-    except (OSError, ValueError):
-        meta = {}
-    verify_checkpoint_payload(payload, meta, path)
     try:
         restored = serialization.from_bytes(target, payload)
     except Exception as e:  # flax/msgpack raise a zoo of decode errors
@@ -320,8 +724,10 @@ def restore_checkpoint(
     ``names`` (e.g. :func:`newest_checkpoint_order`) gives the candidate
     preference; each candidate is expanded with its rolling history, and
     restore falls back through the list on ANY corruption — a truncated
-    payload, a checksum mismatch, or undeserializable bytes all behave
-    like a missing file with a warning, never a crash deep inside flax.
+    payload, a checksum mismatch, a missing or corrupt v3 shard, or
+    undeserializable bytes all behave like a missing file with a warning,
+    never a crash deep inside flax. A v3 publish without its commit
+    marker is treated as absent (never reassembled from loose shards).
     Raises FileNotFoundError only when NO candidate is usable.
 
     Returns (state, start_epoch, best_acc); start_epoch is the next epoch
@@ -341,12 +747,12 @@ def restore_checkpoint(
         "opt_state": jax.device_get(state.opt_state),
         "step": np.zeros((), np.int32),
     }
-    # Saves are process-0-only, so under multi-host without a shared
-    # filesystem only process 0 sees the files. Process 0 walks the
-    # candidate order, decides which checkpoint wins, and every process
-    # follows that decision via broadcast — no per-host file requirement,
-    # and no host can diverge (raise vs proceed, or restore DIFFERENT
-    # candidates) and deadlock the collective job.
+    # Under multi-host process 0 walks the candidate order, decides which
+    # checkpoint wins (reassembling any sharded candidate itself — the
+    # sharded format requires a shared checkpoint dir), and every process
+    # follows that decision via broadcast — no host can diverge (raise vs
+    # proceed, or restore DIFFERENT candidates) and deadlock the
+    # collective job.
     restored = None
     epoch, best_acc = -1, 0.0
     if jax.process_index() == 0:

@@ -51,6 +51,7 @@ from pytorch_cifar_tpu.parallel.mesh import is_primary
 from pytorch_cifar_tpu.train.checkpoint import (
     CKPT_NAME,
     LAST_NAME,
+    AsyncCheckpointWriter,
     best_checkpoint_order,
     meta_path,
     remove_stale_last,
@@ -127,6 +128,10 @@ class Trainer:
         if config.async_input not in ("on", "off"):
             raise ValueError(
                 f"async_input must be on/off, got {config.async_input!r}"
+            )
+        if config.async_save not in ("on", "off"):
+            raise ValueError(
+                f"async_save must be on/off, got {config.async_save!r}"
             )
         device_data = config.device_data and not host_aug
 
@@ -421,13 +426,22 @@ class Trainer:
         self._trace_dir = None  # set by fit() for the profiled epoch
         self.profile_steps = 20
         self._stop_requested = False
-        # async best-checkpoint machinery: device-side snapshot + writer
-        # thread (see maybe_checkpoint)
+        # async best-checkpoint machinery: device-side snapshot (taken on
+        # every improvement, so the pipelined fit's buffer donation can
+        # never invalidate the best state) + the checkpoint module's
+        # background commit thread (see maybe_checkpoint; the writer
+        # itself lives in checkpoint.AsyncCheckpointWriter — serialization
+        # + CRC + fsync'd commit off the training thread, one pending
+        # save, errors re-raised on the next trainer interaction)
         self._copy_state = jax.jit(
             lambda s: jax.tree_util.tree_map(jnp.copy, s)
         )
         self._snapshot = None  # (state copy, epoch, best_acc)
-        self._save_thread = None
+        self._ckpt_writer = (
+            AsyncCheckpointWriter(registry=self.obs)
+            if config.async_save == "on"
+            else None
+        )
         self._written_epoch = None
         # divergence-sentinel policy state (ROBUSTNESS.md): consecutive
         # non-finite-step counter; totals live in the obs registry now
@@ -523,6 +537,10 @@ class Trainer:
             newest_checkpoint_order,
         )
 
+        if self._ckpt_writer is not None:
+            # the newest save may still be in the writer queue; a rollback
+            # must restore the actual newest on-disk state, so drain it
+            self._ckpt_writer.flush()
         try:
             state, _, _ = restore_checkpoint(
                 self.config.output_dir,
@@ -800,13 +818,14 @@ class Trainer:
     ) -> bool:
         """Best-accuracy checkpoint gate (reference semantics,
         main.py:136-148) — but the disk write is decoupled from the
-        training loop: the best state is snapshotted on DEVICE (a
-        device-to-device copy, microseconds) and streamed to disk by a
-        background thread. Through a slow host transport the synchronous
-        alternative — ~100 MB of device_get at ~7.5 MB/s — costs ~14 s,
-        ten times the epoch it interrupts (measured; BENCHMARKS.md).
-        ``flush_checkpoints`` (called by fit) guarantees the newest
-        snapshot is on disk before the run ends.
+        training loop (--async_save on): the best state is snapshotted on
+        DEVICE on every improvement (a device-to-device copy,
+        microseconds), disk writes are throttled to --checkpoint_every,
+        and an actual write pays only the device_get on this thread —
+        serialization, CRC, and the fsync'd commit run on the checkpoint
+        module's background writer (checkpoint.AsyncCheckpointWriter;
+        ROBUSTNESS.md). ``flush_checkpoints`` (called by fit) guarantees
+        the newest snapshot is durably on disk before the run ends.
 
         ``snap_state``: a device-side copy of the state that achieved
         ``acc``, taken by the caller. The pipelined fit loop must pass it:
@@ -816,7 +835,7 @@ class Trainer:
         if acc > self.best_acc:
             self.best_acc = acc
             log.info("Saving.. (best acc %.2f%%)", acc)
-            if not self.config.async_checkpoint:
+            if self._ckpt_writer is None:
                 save_checkpoint(
                     self.config.output_dir,
                     self.state if snap_state is None else snap_state,
@@ -833,17 +852,16 @@ class Trainer:
                 epoch,
                 self.best_acc,
             )
-            self._kick_async_save()
+            self._write_snapshot_async()
             return True
         return False
 
-    def _kick_async_save(self) -> None:
-        import threading
-
-        if self._save_thread is not None and self._save_thread.is_alive():
-            # a write is in flight; flush_checkpoints picks up this newer
-            # snapshot later (or the next kick does)
-            return
+    def _write_snapshot_async(self) -> None:
+        """Hand the current best-state snapshot to the background writer
+        (unless throttled). Only the device_get snapshot blocks this
+        thread; serialization + commit run on the writer, which keeps at
+        most ONE pending save (a newer snapshot supersedes a queued one)
+        and re-raises any background failure on the next submit/flush."""
         snap = self._snapshot
         if snap is None or snap[1] == self._written_epoch:
             return
@@ -853,8 +871,9 @@ class Trainer:
             and snap[1] - self._written_epoch < self.config.checkpoint_every
         ):
             # too soon: keep the device snapshot current but skip the disk
-            # write (each one stalls training ~14 s on a serialized host
-            # link); flush_checkpoints writes the final best regardless
+            # write (even the on-thread device_get stalls training ~14 s
+            # on a serialized host link); flush_checkpoints writes the
+            # final best regardless
             log.info(
                 "checkpoint write throttled (epoch %d; last on-disk best is "
                 "epoch %d, next write at epoch >= %d) — a crash before then "
@@ -864,47 +883,29 @@ class Trainer:
                 self._written_epoch + self.config.checkpoint_every,
             )
             return
-
-        def work():
-            # _written_epoch is only advanced on SUCCESS: a failed write
-            # (disk full, dir deleted) is logged here and retried
-            # synchronously by flush_checkpoints — which then propagates
-            # the error instead of reporting a phantom checkpoint
-            try:
-                save_checkpoint(
-                    self.config.output_dir, snap[0], snap[1], snap[2],
-                    keep_last_n=self.config.keep_last_n,
-                    registry=self.obs,
-                )
-                # graftcheck: noqa[unlocked-shared-mutation] -- single writer by construction: at most one ckpt-writer thread exists (is_alive gate in _kick_async_save) and readers resynchronize via join() in flush_checkpoints
-                self._written_epoch = snap[1]
-            except Exception:
-                log.exception(
-                    "async checkpoint write failed (epoch %d)", snap[1]
-                )
-
-        # graftcheck: noqa[unlocked-shared-mutation] -- only the training thread ever assigns the writer handle, and it first proves the previous writer dead via is_alive(); the hot loop stays lock-free by design
-        self._save_thread = threading.Thread(
-            target=work, name="ckpt-writer", daemon=True
+        save_checkpoint(
+            self.config.output_dir, snap[0], snap[1], snap[2],
+            keep_last_n=self.config.keep_last_n,
+            registry=self.obs,
+            writer=self._ckpt_writer,
         )
-        self._save_thread.start()
+        self._written_epoch = snap[1]
 
     def flush_checkpoints(self) -> None:
-        """Block until the newest best-state snapshot is on disk. A write
-        that failed in the background is retried here synchronously, so
-        persistent failures raise instead of vanishing."""
-        t = self._save_thread
-        if t is not None:
-            t.join()
+        """Block until the newest best-state snapshot is durably on disk.
+        A background write that failed is re-raised here (the writer
+        stores it), so persistent failures raise instead of vanishing."""
         snap = self._snapshot
         if snap is not None and snap[1] != self._written_epoch:
             save_checkpoint(
                 self.config.output_dir, snap[0], snap[1], snap[2],
                 keep_last_n=self.config.keep_last_n,
                 registry=self.obs,
+                writer=self._ckpt_writer,
             )
-            # graftcheck: noqa[unlocked-shared-mutation] -- runs strictly after t.join() above, so the writer thread is finished; happens-before makes this store race-free
             self._written_epoch = snap[1]
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.flush()
 
     def fit(self) -> float:
         cfg = self.config
@@ -1023,6 +1024,7 @@ class Trainer:
                         name=LAST_NAME,
                         keep_last_n=cfg.keep_last_n,
                         registry=self.obs,
+                        writer=self._ckpt_writer,
                     )
                     break
             else:
@@ -1048,11 +1050,18 @@ class Trainer:
                         pending[0],
                     )
             # the newest best-state snapshot must be on disk before the
-            # process can exit (async writer, maybe_checkpoint)
-            self.flush_checkpoints()
-            self._close_obs()
-            if old_handler is not None:
-                signal.signal(signal.SIGTERM, old_handler)
+            # process can exit (async writer, maybe_checkpoint); the
+            # writer join and obs shutdown run even when the flush
+            # re-raises a stored background write error — no thread leak
+            # on any exit path
+            try:
+                self.flush_checkpoints()
+            finally:
+                if self._ckpt_writer is not None:
+                    self._ckpt_writer.close()
+                self._close_obs()
+                if old_handler is not None:
+                    signal.signal(signal.SIGTERM, old_handler)
         return self.best_acc
 
     def _close_obs(self) -> None:

@@ -100,10 +100,16 @@ def main() -> int:
         std=cfg.std,
         registry=registry,
         mesh=mesh,
+        # AOT executable cache (SERVING.md): warm replicas import the
+        # bucket programs instead of recompiling (verified by probe) —
+        # the autoscaling cold-start path
+        aot_cache_dir=cfg.aot_cache or None,
     )
     print(
-        f"==> warm: {engine.compile_count} bucket programs compiled "
-        f"(buckets {engine.buckets}, {n_devices} device(s)), "
+        f"==> warm: {engine.compile_count} bucket programs compiled, "
+        f"{engine.aot_cache_hits} imported from the AOT cache "
+        f"({engine.cold_start_s:.2f}s cold start; buckets "
+        f"{engine.buckets}, {n_devices} device(s)), "
         f"checkpoint meta {engine.checkpoint_meta}",
         file=sys.stderr,
     )
@@ -198,6 +204,11 @@ def main() -> int:
         "max_batch": batcher.max_batch,
         "max_wait_ms": cfg.max_wait_ms,
         "compiles": compiles_after,
+        # replica cold-start health (SERVING.md "AOT executable cache"):
+        # with a warm cache, compiles == 0 and cold_start_s is load time
+        "cold_start_s": round(engine.cold_start_s, 3),
+        "aot_cache_hits": engine.aot_cache_hits,
+        "aot_cache_misses": engine.aot_cache_misses,
         "engine_version": engine.version,
         "ckpt_epoch": engine.checkpoint_meta.get("epoch"),
         "reloads": watcher.reloads if watcher is not None else 0,

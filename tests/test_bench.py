@@ -232,3 +232,28 @@ def test_parse_child_record_skips_non_record_json_lines():
     assert bench.parse_child_record(stdout) == newer
     assert bench.parse_child_record("no json here\n{broken\n") is None
     assert bench.parse_child_record("") is None
+
+
+def test_bench_ckpt_mode_prints_one_json_line():
+    """--ckpt (async checkpointing + AOT cold-start PR): the async-vs-
+    sync save-stall A/B and the cold-start-with/without-AOT-cache timings
+    ride one driver-contract record. Schema pins: bit-identical files
+    between the modes, zero compiles from a warm cache, matching logits."""
+    rec, _ = run_bench(["--ckpt", "--model", "LeNet"])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"] == "ckpt_async_stall_LeNet_cpu", rec["metric"]
+    assert rec["unit"] == "x"
+    assert rec["value"] > 0
+    assert rec["sync_stall_ms"] > 0 and rec["async_stall_ms"] > 0
+    assert rec["value"] == pytest.approx(
+        rec["sync_stall_ms"] / rec["async_stall_ms"], rel=0.01
+    )
+    assert rec["writer_ms_p50"] > 0  # the commit cost moved off-thread
+    assert rec["saved_bytes"] > 0  # equal bytes: same state, both modes
+    assert rec["bit_identical"] is True
+    cs = rec["cold_start"]
+    assert cs["compiles_no_cache"] == 2  # two buckets, freshly compiled
+    assert cs["compiles_warm"] == 0  # THE cold-start acceptance pin
+    assert cs["cache_hits"] == 2
+    assert cs["logits_match"] is True
+    assert cs["no_cache_s"] > 0 and cs["warm_cache_s"] > 0

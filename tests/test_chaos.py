@@ -125,3 +125,22 @@ def test_sharded_serve_drill_hot_reload_and_kill(tmp_path):
     assert rec["ckpt_epoch_served"] == rec["ckpt_epoch_published"]
     assert rec["killed_rc"] == -9
     assert rec["recovery_s"] > 0
+
+
+def test_ckpt_drill_kill_mid_async_save_and_torn_v3(tmp_path):
+    """--mode ckpt (format v3 + async writer PR): SIGKILL lands inside a
+    stalled async commit window (saves every epoch, commits stalled
+    between payload and sidecar) and --resume recovers to the reference
+    result; then a NEWER sharded preemption save with a truncated shard
+    is planted — ckpt_inspect must flag it, the resume must fall back
+    past it (no torn v3 ever restored), and the relaunched run must
+    still match the reference."""
+    rec = run_chaos("ckpt", tmp_path)
+    assert rec["match"] is True
+    assert rec["killed_rc"] == -9
+    assert rec["finite"] is True
+    assert rec["max_abs_diff"] <= rec["tol"]
+    assert rec["inspect_rc_torn"] == 1  # the torn shard was named
+    assert rec["torn_v3_rejected"] is True  # fell back, never restored
+    assert rec["inspect_rc_after"] == 0  # dir is clean again
+    assert rec["recovery_s"] > 0

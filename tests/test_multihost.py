@@ -92,6 +92,21 @@ def test_two_process_spmd_matches_single_process(tmp_path):
     # checkpoint broadcast restore worked on every process
     assert all(r["resumed_epoch"] == 2 for r in two + [one])
 
+    # sharded save/restore agreement (checkpoint format v3): the
+    # 2-process job published one shard PER PROCESS plus process-0's
+    # commit marker listing both — and the restores above (same psum on
+    # every rank) reassembled exactly that set. The 1-process comparator
+    # stays on the single-host v2 layout.
+    mh = tmp_path / "mh"
+    meta = json.loads((mh / "ckpt.json").read_text())
+    assert meta["format"] == 3 and len(meta["shards"]) == 2
+    for s in meta["shards"]:
+        assert (mh / s["name"]).is_file()
+    assert not (mh / "ckpt.msgpack").exists()
+    assert sum(s["size"] for s in meta["shards"]) == meta["total"]["size"]
+    sp_meta = json.loads((tmp_path / "sp" / "ckpt.json").read_text())
+    assert "shards" not in sp_meta and sp_meta["manifest"]["format"] == 2
+
 
 def test_cross_topology_checkpoint_resume(tmp_path):
     """Cross-topology resume (VERDICT round 4, weak 6): a checkpoint

@@ -297,7 +297,7 @@ def test_trainer_trace_out_nested_train_and_checkpoint_spans(
         # spans (the one-dispatch path has no host-visible steps)
         device_data=False,
         host_augment=True,
-        async_checkpoint=False,
+        async_save="off",
     )
     Trainer(cfg).fit()
     trace.uninstall(flush=False)  # fit() already flushed
@@ -321,7 +321,7 @@ def test_trainer_registry_and_fault_stats_view(small_cfg):
     over the same registry (single source of truth)."""
     from pytorch_cifar_tpu.train.trainer import Trainer
 
-    cfg = small_cfg(epochs=1, async_checkpoint=False)
+    cfg = small_cfg(epochs=1, async_save="off")
     tr = Trainer(cfg)
     tr.fit()
     s = tr.obs.summary()

@@ -868,3 +868,199 @@ def test_serve_cli_end_to_end(tmp_path):
     )
     assert rec["n_devices"] == 1
     assert rec["buckets"] == [1, 4] and rec["compiles"] == 2
+
+
+# -- AOT executable cache (SERVING.md "instant replica cold-start") ------
+
+
+def test_aot_cache_cold_start_zero_compiles(tmp_path):
+    """THE cold-start acceptance pin: engine #1 compiles and exports;
+    engine #2 imports with ZERO bucket compiles (every entry verified by
+    probe + one bucket against a fresh reference) and serves logits
+    bit-identical to the freshly compiled engine."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    cache = str(tmp_path / "aot")
+    reg = MetricsRegistry()
+    e1 = InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4), compute_dtype=jnp.float32,
+        aot_cache_dir=cache, registry=reg,
+    )
+    assert e1.compile_count == 2
+    assert e1.aot_cache_misses == 2 and e1.aot_cache_hits == 0
+    # entries + manifest sidecars are on disk, atomically published
+    entries = sorted(os.listdir(cache))
+    assert len(entries) == 4  # 2 payloads + 2 sidecars
+    assert all(".aotx" in n for n in entries)
+
+    reg2 = MetricsRegistry()
+    e2 = InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4), compute_dtype=jnp.float32,
+        aot_cache_dir=cache, registry=reg2,
+    )
+    assert e2.compile_count == 0  # the acceptance criterion
+    assert e2.aot_cache_hits == 2 and e2.aot_cache_misses == 0
+    for n in (1, 3, 4, 9):  # padding + chunking through imported programs
+        x = _images(n, seed=n)
+        np.testing.assert_array_equal(e2.predict(x), e1.predict(x))
+    # obs counters mirror the attributes; cold start was recorded
+    s2 = reg2.summary()
+    assert s2["serve.aot_cache_hits"] == 2.0
+    assert s2.get("serve.compiles", 0.0) == 0.0
+    assert reg2.gauge("serve.cold_start_s").value > 0.0
+    # a cached engine still refuses unknown shapes (AOT contract intact)
+    with pytest.raises(Exception):
+        e2._compiled[4](*e2._weights, _images(5))
+
+
+def test_aot_cache_mesh_engine_zero_compiles(tmp_path):
+    """The mesh engine's sharded bucket programs export/import too (the
+    autoscaling replica case) — and stay bit-identical to the
+    single-device oracle through the cache."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    cache = str(tmp_path / "aot")
+    p, s = _lenet_weights(seed=3)
+    e1 = InferenceEngine(
+        "LeNet", p, s, buckets=(8,), compute_dtype=jnp.float32,
+        mesh=make_mesh(), aot_cache_dir=cache,
+    )
+    assert e1.compile_count == 1
+    e2 = InferenceEngine(
+        "LeNet", p, s, buckets=(8,), compute_dtype=jnp.float32,
+        mesh=make_mesh(), aot_cache_dir=cache,
+    )
+    assert e2.compile_count == 0 and e2.aot_cache_hits == 1
+    x = _images(11, seed=4)
+    np.testing.assert_array_equal(e2.predict(x), e1.predict(x))
+    np.testing.assert_array_equal(e2.predict(x), e2.direct_forward(x))
+
+
+def test_aot_cache_probe_mismatch_poisons_and_recompiles(tmp_path):
+    """A cache entry whose probe expectation cannot be reproduced (the
+    jaxlib deserialization-bug class, ROBUSTNESS.md) is refused: the
+    engine compiles instead, the entry is marked poisoned, and later
+    engines treat it as a permanent miss — never a silent wrong-logits
+    import."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import InferenceEngine, aot_cache
+    from pytorch_cifar_tpu.train.checkpoint import (
+        _atomic_write,
+        payload_manifest,
+    )
+
+    cache = str(tmp_path / "aot")
+    InferenceEngine.from_random(
+        "LeNet", buckets=(4,), compute_dtype=jnp.float32,
+        aot_cache_dir=cache,
+    )
+    (entry_file,) = [
+        n for n in os.listdir(cache) if n.endswith(".aotx")
+    ]
+    # tamper the stored probe expectation but keep the manifest valid:
+    # only the probe check (not the CRC) can catch this
+    path = os.path.join(cache, entry_file)
+    with open(path, "rb") as f:
+        entry = pickle.loads(f.read())
+    # negate rather than offset: robust at any logit magnitude (an
+    # additive tamper below the float32 ulp would be a silent no-op)
+    entry["probe_logits"] = -np.asarray(entry["probe_logits"])
+    payload = pickle.dumps(entry)
+    _atomic_write(path, payload)
+    meta_p = path + ".json"
+    meta = json.load(open(meta_p))
+    meta["manifest"] = payload_manifest(payload)
+    _atomic_write(meta_p, json.dumps(meta).encode())
+
+    e2 = InferenceEngine.from_random(
+        "LeNet", buckets=(4,), compute_dtype=jnp.float32,
+        aot_cache_dir=cache,
+    )
+    assert e2.compile_count == 1  # fell back to compiling
+    assert e2.aot_cache_hits == 0 and e2.aot_cache_misses == 1
+    assert json.load(open(meta_p))["poisoned"] is True
+    # the poisoned entry stays a miss (and is not silently re-exported
+    # over — the poison marker is the tombstone)
+    e3 = InferenceEngine.from_random(
+        "LeNet", buckets=(4,), compute_dtype=jnp.float32,
+        aot_cache_dir=cache,
+    )
+    assert e3.compile_count == 1 and e3.aot_cache_misses == 1
+
+
+def test_torn_aot_cache_entry_is_a_miss(tmp_path):
+    """A truncated entry (kill mid-export without the atomic write, or
+    disk corruption) fails its manifest and reads as a miss — the XLA
+    deserializer never sees garbage bytes."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.faults import truncate_file
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    cache = str(tmp_path / "aot")
+    InferenceEngine.from_random(
+        "LeNet", buckets=(4,), compute_dtype=jnp.float32,
+        aot_cache_dir=cache,
+    )
+    (entry_file,) = [n for n in os.listdir(cache) if n.endswith(".aotx")]
+    truncate_file(os.path.join(cache, entry_file))
+    e2 = InferenceEngine.from_random(
+        "LeNet", buckets=(4,), compute_dtype=jnp.float32,
+        aot_cache_dir=cache,
+    )
+    assert e2.compile_count == 1 and e2.aot_cache_misses == 1
+
+
+# -- sharded (format v3) checkpoints on the serving side -----------------
+
+
+def test_v3_checkpoint_loads_and_hot_reloads(tmp_path):
+    """A sharded (format v3) trainer checkpoint serves: the loader
+    reassembles the committed shards (manifest-verified), and the watcher
+    picks up a NEW v3 publish — its signature is the commit marker, so
+    shards landing first can never trigger a premature reload."""
+    import jax
+
+    from pytorch_cifar_tpu.serve import CheckpointWatcher, InferenceEngine
+    from pytorch_cifar_tpu.serve.engine import load_checkpoint_trees
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+    import jax.numpy as jnp
+
+    def make_state(seed):
+        model = create_model("LeNet")
+        tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2)
+        return create_train_state(model, jax.random.PRNGKey(seed), tx)
+
+    save_checkpoint(
+        str(tmp_path), make_state(0), epoch=1, best_acc=10.0, num_shards=2
+    )
+    assert not os.path.isfile(tmp_path / "ckpt.msgpack")  # really v3
+    params, stats, meta = load_checkpoint_trees(str(tmp_path), "LeNet")
+    assert meta["epoch"] == 1 and meta["format"] == 3
+
+    eng = InferenceEngine.from_checkpoint(
+        str(tmp_path), "LeNet", buckets=(4,), compute_dtype=jnp.float32
+    )
+    watcher = CheckpointWatcher(eng, str(tmp_path), poll_s=3600)
+    x = _images(3, seed=2)
+    before = eng.predict(x)
+    save_checkpoint(
+        str(tmp_path), make_state(7), epoch=2, best_acc=20.0, num_shards=2
+    )
+    assert watcher.poll_once() is True
+    after = eng.predict(x)
+    assert eng.version == 1 and watcher.reloads == 1
+    assert not np.array_equal(before, after)
+    assert np.array_equal(after, eng.direct_forward(x))

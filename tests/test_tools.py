@@ -603,3 +603,74 @@ def test_googlenet_ab_smoke():
     assert len(lines) == 3, out.stdout
     assert any("stock" in l for l in lines)
     assert any("merged_1x1 " in l or "merged_1x1:" in l for l in lines)
+
+
+# -- tools/ckpt_inspect.py (checkpoint dir verifier) ---------------------
+
+
+def _inspect(ckpt_dir, *extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_inspect.py"),
+         str(ckpt_dir), *extra],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+
+
+def test_ckpt_inspect_verifies_v2_and_v3_and_flags_corruption(tmp_path):
+    """The driver contract (ROBUSTNESS.md tooling): lists every
+    checkpoint's format/shards, verifies manifests + commit markers,
+    exits 0 clean / 1 on corruption; orphan shards (torn publish without
+    a commit marker — invisible to restore) are warnings, not failures."""
+    import jax
+
+    from pytorch_cifar_tpu.faults import truncate_file
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import (
+        LAST_NAME,
+        save_checkpoint,
+        shard_name,
+    )
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    state = create_train_state(
+        create_model("LeNet"), jax.random.PRNGKey(0),
+        make_optimizer(lr=0.1, t_max=2, steps_per_epoch=2),
+    )
+    out = tmp_path / "ckpt"
+    save_checkpoint(str(out), state, 1, 10.0)  # v2
+    save_checkpoint(
+        str(out), state, 5, 50.0, name=LAST_NAME, num_shards=3
+    )  # v3
+
+    r = _inspect(out, "--json")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    by_name = {c["name"]: c for c in rep["checkpoints"]}
+    assert by_name["ckpt.msgpack"]["format"] == 2
+    assert by_name["last.msgpack"]["format"] == 3
+    assert len(by_name["last.msgpack"]["shards"]) == 3
+    assert rep["ok"] is True and rep["corrupt"] == []
+
+    # truncate one COMMITTED shard -> corruption, named, exit 1
+    truncate_file(str(out / shard_name(LAST_NAME, 1, 3)))
+    r = _inspect(out, "--json")
+    assert r.returncode == 1
+    rep = json.loads(r.stdout)
+    assert rep["corrupt"] == ["last.msgpack"]
+    assert any(
+        "shard00001" in p
+        for c in rep["checkpoints"] for p in c["problems"]
+    )
+
+    # remove the commit marker -> the shards become orphans of a torn
+    # publish: invisible to restore, so a warning, not a failure
+    os.remove(out / "last.json")
+    r = _inspect(out, "--json")
+    assert r.returncode == 0, r.stdout
+    rep = json.loads(r.stdout)
+    assert len(rep["orphan_shards"]) == 3
+    assert rep["ok"] is True
+
+    # not-a-directory is a usage error (exit 2)
+    assert _inspect(tmp_path / "nope").returncode == 2

@@ -29,12 +29,21 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
              relaunch-to-completion). No weight bits may be dropped:
              the relaunched server's ckpt_epoch must equal the published
              checkpoint's epoch and its compile count must stay pinned.
+  ckpt     — checkpoint-layer drill (ROBUSTNESS.md "format v3 + async
+             writer"): SIGKILL lands inside a stalled async commit
+             window (PCT_FAULTS=ckpt_write_stall, saves every epoch) and
+             --resume recovers to the reference result; then a NEWER
+             sharded (v3) preemption save with a truncated shard is
+             planted — tools/ckpt_inspect.py must flag it, the resume
+             must fall back past it (no torn v3 ever restored), and the
+             final state must still match the reference run.
 
 Usage:
   python tools/chaos_run.py --mode sigterm
   python tools/chaos_run.py --mode corrupt --corruption bitflip
   python tools/chaos_run.py --mode nan --epochs 3
   python tools/chaos_run.py --mode serve --serve-devices 8
+  python tools/chaos_run.py --mode ckpt
 
 Subprocess-only: this driver never initializes a jax backend (the child
 runs own the device); comparisons read the msgpack checkpoints directly.
@@ -57,7 +66,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def train_cmd(args, out_dir: str, resume: bool = False):
+def train_cmd(args, out_dir: str, resume: bool = False, extra=()):
     cmd = [
         sys.executable, os.path.join(REPO, "train.py"),
         "--model", args.model,
@@ -75,6 +84,7 @@ def train_cmd(args, out_dir: str, resume: bool = False):
     ]
     if resume:
         cmd.append("--resume")
+    cmd.extend(extra)
     return cmd
 
 
@@ -368,10 +378,145 @@ def serve_drill(args, work: str) -> dict:
     }
 
 
+def _inspect(ckpt_dir: str) -> int:
+    """tools/ckpt_inspect.py verdict for ``ckpt_dir`` (exit code)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_inspect.py"),
+         ckpt_dir],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    sys.stderr.write(r.stdout[-1500:])
+    return r.returncode
+
+
+def ckpt_drill(args, work: str) -> dict:
+    """The checkpoint drill (ROBUSTNESS.md "format v3 + async writer"):
+
+    1. SIGKILL mid-async-save: ``ckpt_write_stall`` stalls every commit
+       between payload/shard and sidecar/commit-marker writes, and the
+       run saves on EVERY improvement (``--checkpoint_every 0``), so the
+       kill lands inside the torn-pair window with high probability;
+       ``--resume`` must restore the newest COMPLETE checkpoint and
+       re-run the lost epochs to the reference result.
+    2. Torn v3 mid-shard-write: a newer sharded preemption save is
+       published and one shard truncated (the deterministic equivalent
+       of a kill mid-shard-write with the commit marker already down);
+       ``ckpt_inspect`` must flag it, the resume must FALL BACK past it
+       (never restoring torn v3 bytes), and the final state must still
+       match the reference run.
+    """
+    dir_ref = os.path.join(work, "reference")
+    dir_chaos = os.path.join(work, "chaos")
+
+    print(f"==> [ckpt] reference run -> {dir_ref}", file=sys.stderr)
+    ref_s = run_to_completion(
+        train_cmd(args, dir_ref), child_env(), args.timeout
+    )
+
+    # phase 1 — SIGKILL mid-async-save (stalled commit window)
+    print(
+        f"==> [ckpt] stalled-writer run -> {dir_chaos} "
+        "(save every epoch, commits stalled)", file=sys.stderr,
+    )
+    proc = subprocess.Popen(
+        train_cmd(args, dir_chaos, extra=("--checkpoint_every", "0")),
+        env=child_env({"PCT_FAULTS": "ckpt_write_stall=800"}),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO,
+    )
+    wait_for_checkpoint(dir_chaos, proc, args.timeout)
+    time.sleep(args.kill_delay_s)
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+    proc.communicate(timeout=args.timeout)
+    killed_rc = proc.returncode
+    print(f"==> [ckpt] resuming {dir_chaos}", file=sys.stderr)
+    t0 = time.monotonic()
+    run_to_completion(
+        train_cmd(args, dir_chaos, resume=True), child_env(), args.timeout
+    )
+    recovery_s = time.monotonic() - t0
+
+    # phase 2 — torn v3: newer sharded preemption save with a truncated
+    # shard (commit marker intact, so only manifest verification can
+    # reject it); the resume order prefers it by epoch
+    helper = (
+        "import sys; sys.path.insert(0, sys.argv[2])\n"
+        "from pytorch_cifar_tpu import honor_platform_env\n"
+        "honor_platform_env()\n"
+        "import jax\n"
+        "from pytorch_cifar_tpu.models import create_model\n"
+        "from pytorch_cifar_tpu.train.optim import make_optimizer\n"
+        "from pytorch_cifar_tpu.train.state import create_train_state\n"
+        "from pytorch_cifar_tpu.train.checkpoint import LAST_NAME, "
+        "save_checkpoint\n"
+        "state = create_train_state(create_model(sys.argv[3]), "
+        "jax.random.PRNGKey(99), make_optimizer(lr=0.1, t_max=3, "
+        "steps_per_epoch=4))\n"
+        "save_checkpoint(sys.argv[1], state, epoch=9, best_acc=99.0, "
+        "name=LAST_NAME, num_shards=4)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", helper, dir_chaos, REPO, args.model],
+        env=child_env(), capture_output=True, text=True,
+        timeout=args.timeout, cwd=REPO,
+    )
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise SystemExit("torn-v3 helper failed")
+    from pytorch_cifar_tpu import faults
+
+    victim = os.path.join(dir_chaos, "last.shard00002-of-00004.msgpack")
+    faults.truncate_file(victim)
+    print(f"==> [ckpt] truncated {victim}", file=sys.stderr)
+    inspect_rc_torn = _inspect(dir_chaos)  # must flag the torn shard
+
+    print(f"==> [ckpt] resuming past the torn v3 save", file=sys.stderr)
+    rr = subprocess.run(
+        train_cmd(args, dir_chaos, resume=True),
+        env=child_env(), capture_output=True, text=True,
+        timeout=args.timeout, cwd=REPO,
+    )
+    if rr.returncode != 0:
+        sys.stderr.write(rr.stdout[-2000:] + "\n" + rr.stderr[-4000:])
+        raise SystemExit(f"torn-v3 resume failed rc={rr.returncode}")
+    torn_rejected = (
+        "is corrupt" in rr.stderr and "falling back" in rr.stderr
+    )
+    inspect_rc_after = _inspect(dir_chaos)  # stale last removed; clean
+
+    cmp = compare(dir_ref, dir_chaos)
+    tol = args.tol if args.tol is not None else 1e-6
+    ok = (
+        cmp["finite"]
+        and cmp["max_abs_diff"] <= tol
+        and cmp["best_epoch_ref"] == cmp["best_epoch_chaos"]
+        and killed_rc == -int(signal.SIGKILL)
+        and inspect_rc_torn == 1
+        and torn_rejected
+        and inspect_rc_after == 0
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "ckpt",
+        "match": ok,
+        "tol": tol,
+        "reference_s": round(ref_s, 2),
+        "recovery_s": round(recovery_s, 2),
+        "killed_rc": killed_rc,
+        "inspect_rc_torn": inspect_rc_torn,
+        "inspect_rc_after": inspect_rc_after,
+        "torn_v3_rejected": torn_rejected,
+        **{k: (round(v, 8) if isinstance(v, float) else v)
+           for k, v in cmp.items()},
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
-        "--mode", choices=("sigterm", "sigkill", "corrupt", "nan", "serve"),
+        "--mode",
+        choices=("sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt"),
         default="sigterm",
     )
     p.add_argument(
@@ -416,8 +561,12 @@ def main() -> int:
 
     work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
 
-    if args.mode == "serve":
-        record = serve_drill(args, work)
+    if args.mode in ("serve", "ckpt"):
+        record = (
+            serve_drill(args, work)
+            if args.mode == "serve"
+            else ckpt_drill(args, work)
+        )
         print(json.dumps(record))
         if record["match"] and not args.out:
             import shutil
