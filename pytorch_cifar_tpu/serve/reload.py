@@ -91,21 +91,31 @@ class CheckpointWatcher:
         return os.path.join(self.ckpt_dir, self.name)
 
     def _signature(self):
-        """Identity of the current checkpoint publication. The save path
-        is atomic tmp+rename, so a new checkpoint is a new inode — (ino,
-        mtime_ns, size) changes on every publish and never mid-write.
-        A sharded (format v3) checkpoint has no single payload file; its
-        commit-marker sidecar — written LAST by the publisher — is the
-        publication identity instead, which also means shards landing
-        before the commit can never trigger a premature reload."""
-        try:
-            st = os.stat(self._path())
-        except OSError:
+        """Identity of the current checkpoint publication: the stat
+        identities of BOTH the payload file and its sidecar. The save
+        path is atomic tmp+rename, so a new publish is a new inode —
+        (ino, mtime_ns, size) changes on every publish and never
+        mid-write. A sharded (format v3) publish updates only the
+        commit-marker sidecar (written LAST) and the shards, leaving any
+        older v2 payload file untouched; statting the sidecar
+        unconditionally — not merely when the payload is absent — is
+        what keeps a dir that transitions v2→v3 (same output_dir reused
+        by a later multihost run) reloading, and still means shards
+        landing before the commit can never trigger a premature
+        reload."""
+
+        def stat_of(path):
             try:
-                st = os.stat(meta_path(self.ckpt_dir, self.name))
+                st = os.stat(path)
             except OSError:
                 return None
-        return (st.st_ino, st.st_mtime_ns, st.st_size)
+            return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+        payload = stat_of(self._path())
+        sidecar = stat_of(meta_path(self.ckpt_dir, self.name))
+        if payload is None and sidecar is None:
+            return None
+        return (payload, sidecar)
 
     def poll_once(self) -> bool:
         """One poll step: reload iff the file signature changed AND the

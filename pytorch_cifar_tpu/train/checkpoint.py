@@ -29,8 +29,14 @@ Saves can be **asynchronous**: ``save_checkpoint(..., writer=...)`` does
 only the device_get snapshot on the calling thread and hands
 serialization + CRC + the fsync'd tmp+rename commit to an
 :class:`AsyncCheckpointWriter` background thread — bounded to ONE pending
-save (a newer save supersedes a queued one), with writer errors re-raised
-on the next submit/flush and a clean join on shutdown.
+save *per checkpoint name* (a newer save of the same file supersedes its
+queued predecessor; saves of different files — e.g. a preemption
+``last.msgpack`` behind a queued best ``ckpt.msgpack`` — queue
+independently and are never dropped), with writer errors re-raised on the
+next submit/flush and a clean join on shutdown. Multihost sharded saves
+always commit inline: per-process writers would make their supersede
+decisions from local queue timing, so hosts could publish different
+epoch sequences and deadlock process 0's shard barrier.
 
 Restore verifies the manifest(s) and falls back through the candidate
 order on ANY corruption (truncated payload, bad msgpack, checksum
@@ -260,11 +266,16 @@ class AsyncCheckpointWriter:
 
     Contract (ROBUSTNESS.md "async writer"):
 
-    - **Bounded to one pending save.** The queue holds at most one
-      not-yet-started commit; submitting while one is queued replaces it
-      (the newer snapshot supersedes — only the newest state matters for
-      durability, and an unbounded queue would let a fast improvement
-      streak pile up minutes of serialized writes).
+    - **Bounded to one pending save per checkpoint name.** The queue
+      holds at most one not-yet-started commit per submit ``key`` (the
+      checkpoint file name); submitting while one with the same key is
+      queued replaces it (the newer snapshot supersedes — only the
+      newest state of a given file matters for durability, and an
+      unbounded queue would let a fast improvement streak pile up
+      minutes of serialized writes). Jobs with DIFFERENT keys queue
+      independently in submit order: a preemption ``last.msgpack`` save
+      can never displace a queued best ``ckpt.msgpack`` commit — every
+      distinct file promised a write gets one.
     - **Errors re-raise on the next trainer interaction.** A failed
       background commit (disk full, dir deleted, barrier timeout) is
       stored and re-raised by the next :meth:`submit`, :meth:`flush`, or
@@ -280,7 +291,10 @@ class AsyncCheckpointWriter:
 
     def __init__(self, registry=None, name: str = "ckpt-writer"):
         self._cond = threading.Condition()
-        self._pending: Optional[Callable[[], Any]] = None
+        # one pending slot per submit key (insertion-ordered: commits of
+        # distinct checkpoint files run FIFO; a re-submitted key keeps
+        # its place in line but carries the newer closure)
+        self._pending: dict = {}
         self._busy = False
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
@@ -291,8 +305,7 @@ class AsyncCheckpointWriter:
     def _publish_depth_locked(self) -> None:
         if self._obs is not None:
             self._obs.gauge("checkpoint.pending_saves").set(
-                (1 if self._pending is not None else 0)
-                + (1 if self._busy else 0)
+                len(self._pending) + (1 if self._busy else 0)
             )
 
     def _raise_pending_error_locked(self) -> None:
@@ -300,16 +313,18 @@ class AsyncCheckpointWriter:
             err, self._error = self._error, None
             raise err
 
-    def submit(self, job: Callable[[], Any]) -> None:
+    def submit(self, job: Callable[[], Any], key: str = "") -> None:
         """Queue ``job`` (a commit closure) for the background thread.
-        Replaces any still-queued older job; re-raises a stored error
-        from an earlier failed commit."""
+        Replaces any still-queued older job with the same ``key`` (the
+        checkpoint file name — jobs for different files never supersede
+        each other); re-raises a stored error from an earlier failed
+        commit."""
         with self._cond:
             self._raise_pending_error_locked()
-            if self._pending is not None:
+            if key in self._pending:
                 if self._obs is not None:
                     self._obs.counter("checkpoint.superseded_saves").inc()
-            self._pending = job
+            self._pending[key] = job
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._run, name=self._name, daemon=True
@@ -321,12 +336,12 @@ class AsyncCheckpointWriter:
     def _run(self) -> None:
         while True:
             with self._cond:
-                while self._pending is None and not self._stopping:
+                while not self._pending and not self._stopping:
                     self._cond.wait()
-                if self._pending is None:
+                if not self._pending:
                     return
-                job = self._pending
-                self._pending = None
+                key = next(iter(self._pending))
+                job = self._pending.pop(key)
                 self._busy = True
                 self._publish_depth_locked()
             t0 = time.perf_counter()
@@ -350,7 +365,7 @@ class AsyncCheckpointWriter:
         """Block until every submitted commit is durably on disk;
         re-raise any background error."""
         with self._cond:
-            while self._pending is not None or self._busy:
+            while self._pending or self._busy:
                 self._cond.wait()
             self._raise_pending_error_locked()
 
@@ -539,6 +554,7 @@ def save_checkpoint(
     registry=None,
     writer: Optional[AsyncCheckpointWriter] = None,
     num_shards: Optional[int] = None,
+    on_commit: Optional[Callable[[], None]] = None,
 ) -> Optional[str]:
     """Write state to ``output_dir``. Returns the primary path on the
     committing process (process 0), None elsewhere.
@@ -557,6 +573,18 @@ def save_checkpoint(
     ``checkpoint.save_stall_ms`` (calling-thread blocked time) either
     way; the commit half records saves/bytes/``save_ms`` on completion
     and the writer records ``checkpoint.writer_ms`` (OBSERVABILITY.md).
+
+    ``on_commit`` (optional): called once, with no arguments, after the
+    commit half succeeds — on the writer thread for async saves, inline
+    otherwise. Never called for a failed or superseded commit, so the
+    trainer can track which epoch is *durably* on disk rather than
+    merely submitted.
+
+    A multihost sharded publish always commits inline even when a
+    ``writer`` is passed: each process's writer would decide superseding
+    from its LOCAL queue timing, so hosts could commit different epoch
+    sequences and starve process 0's shard barrier (it would wait the
+    full timeout for shards a peer's writer silently dropped).
     """
     pidx, pcount = jax.process_index(), jax.process_count()
     n = int(num_shards) if num_shards else (pcount if pcount > 1 else 1)
@@ -568,6 +596,13 @@ def save_checkpoint(
     if n <= 1 and pidx != 0:
         return None
     shard_index = pidx if (pcount > 1 and n > 1) else None
+    if writer is not None and shard_index is not None:
+        log.warning(
+            "async checkpoint writer ignored for the multihost sharded "
+            "save of %s: per-process supersede decisions would desync "
+            "the shard barrier; committing inline", name,
+        )
+        writer = None
     t0 = time.perf_counter()
     with trace.span(
         "checkpoint/save", file=name, epoch=int(epoch), shards=n
@@ -587,15 +622,18 @@ def save_checkpoint(
             )
 
         def commit():
-            return _commit_host_state(
+            r = _commit_host_state(
                 output_dir, name, host_state, epoch, best_acc,
                 keep_last_n, registry, n, shard_index, t0,
             )
+            if on_commit is not None:
+                on_commit()
+            return r
 
         if writer is None:
             commit()
         else:
-            writer.submit(commit)
+            writer.submit(commit, key=name)
     if registry is not None:
         registry.histogram("checkpoint.save_stall_ms").observe(
             (time.perf_counter() - t0) * 1e3

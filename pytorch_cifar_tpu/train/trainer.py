@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import threading
 import time
 from typing import Tuple
 
@@ -432,16 +433,37 @@ class Trainer:
         # background commit thread (see maybe_checkpoint; the writer
         # itself lives in checkpoint.AsyncCheckpointWriter — serialization
         # + CRC + fsync'd commit off the training thread, one pending
-        # save, errors re-raised on the next trainer interaction)
+        # save per checkpoint file, errors re-raised on the next trainer
+        # interaction)
         self._copy_state = jax.jit(
             lambda s: jax.tree_util.tree_map(jnp.copy, s)
         )
         self._snapshot = None  # (state copy, epoch, best_acc)
+        # Async saves are single-host only: under multihost every process
+        # must commit the SAME sequence of sharded publishes, and
+        # per-process writers superseding from local queue timing cannot
+        # guarantee that (a peer dropping epoch N starves process 0's
+        # shard barrier). save_checkpoint enforces the same rule.
         self._ckpt_writer = (
             AsyncCheckpointWriter(registry=self.obs)
-            if config.async_save == "on"
+            if config.async_save == "on" and jax.process_count() == 1
             else None
         )
+        if config.async_save == "on" and self._ckpt_writer is None:
+            log.info(
+                "--async_save on ignored under multihost (%d processes): "
+                "sharded saves commit inline so every host publishes the "
+                "same epoch sequence", jax.process_count(),
+            )
+        # _submitted_epoch (trainer thread only): newest epoch handed to
+        # save_checkpoint — throttling + duplicate-submit dedupe.
+        # _written_epoch (shared, guarded by _ckpt_lock): newest epoch
+        # whose commit actually SUCCEEDED, advanced by the on_commit
+        # callback on the writer thread — flush_checkpoints re-submits
+        # whenever the snapshot is newer than this, so a failed
+        # background commit can never leave a phantom checkpoint.
+        self._ckpt_lock = threading.Lock()
+        self._submitted_epoch = None
         self._written_epoch = None
         # divergence-sentinel policy state (ROBUSTNESS.md): consecutive
         # non-finite-step counter; totals live in the obs registry now
@@ -856,56 +878,93 @@ class Trainer:
             return True
         return False
 
+    def _mark_epoch_written(self, epoch: int) -> None:
+        """Record ``epoch`` as durably committed. Runs on the writer
+        thread for async saves (hence the lock — graftcheck
+        unlocked-shared-mutation), inline for sync ones."""
+        with self._ckpt_lock:
+            self._written_epoch = epoch
+
+    def _epoch_written(self):
+        with self._ckpt_lock:
+            return self._written_epoch
+
+    def _submit_snapshot(self, snap) -> None:
+        """Hand snapshot ``snap`` to save_checkpoint (async when the
+        writer exists, inline otherwise). ``_submitted_epoch`` advances
+        immediately (this thread owns it); ``_written_epoch`` advances
+        only from the on_commit callback, i.e. once the bytes are
+        actually on disk."""
+        epoch = snap[1]
+        save_checkpoint(
+            self.config.output_dir, snap[0], epoch, snap[2],
+            keep_last_n=self.config.keep_last_n,
+            registry=self.obs,
+            writer=self._ckpt_writer,
+            on_commit=lambda: self._mark_epoch_written(epoch),
+        )
+        self._submitted_epoch = epoch
+
     def _write_snapshot_async(self) -> None:
         """Hand the current best-state snapshot to the background writer
         (unless throttled). Only the device_get snapshot blocks this
         thread; serialization + commit run on the writer, which keeps at
-        most ONE pending save (a newer snapshot supersedes a queued one)
-        and re-raises any background failure on the next submit/flush."""
+        most ONE pending save per checkpoint file (a newer snapshot
+        supersedes a queued one) and re-raises any background failure on
+        the next submit/flush."""
         snap = self._snapshot
-        if snap is None or snap[1] == self._written_epoch:
+        if snap is None or snap[1] == self._submitted_epoch:
             return
         if (
-            self._written_epoch is not None
+            self._submitted_epoch is not None
             and self.config.checkpoint_every > 0
-            and snap[1] - self._written_epoch < self.config.checkpoint_every
+            and snap[1] - self._submitted_epoch < self.config.checkpoint_every
         ):
             # too soon: keep the device snapshot current but skip the disk
             # write (even the on-thread device_get stalls training ~14 s
             # on a serialized host link); flush_checkpoints writes the
             # final best regardless
             log.info(
-                "checkpoint write throttled (epoch %d; last on-disk best is "
+                "checkpoint write throttled (epoch %d; last saved best is "
                 "epoch %d, next write at epoch >= %d) — a crash before then "
                 "resumes from the on-disk state",
                 snap[1],
-                self._written_epoch,
-                self._written_epoch + self.config.checkpoint_every,
+                self._submitted_epoch,
+                self._submitted_epoch + self.config.checkpoint_every,
             )
             return
-        save_checkpoint(
-            self.config.output_dir, snap[0], snap[1], snap[2],
-            keep_last_n=self.config.keep_last_n,
-            registry=self.obs,
-            writer=self._ckpt_writer,
-        )
-        self._written_epoch = snap[1]
+        self._submit_snapshot(snap)
 
     def flush_checkpoints(self) -> None:
         """Block until the newest best-state snapshot is durably on disk.
         A background write that failed is re-raised here (the writer
-        stores it), so persistent failures raise instead of vanishing."""
+        stores it), so persistent failures raise instead of vanishing.
+        The re-submit decision compares against ``_written_epoch`` — the
+        durably-committed epoch, not the merely-submitted one — so a
+        snapshot whose earlier background commit failed (its error
+        already consumed by a prior interaction) is written again rather
+        than assumed on disk."""
         snap = self._snapshot
-        if snap is not None and snap[1] != self._written_epoch:
-            save_checkpoint(
-                self.config.output_dir, snap[0], snap[1], snap[2],
-                keep_last_n=self.config.keep_last_n,
-                registry=self.obs,
-                writer=self._ckpt_writer,
-            )
-            self._written_epoch = snap[1]
+        if snap is not None and snap[1] != self._submitted_epoch:
+            self._submit_snapshot(snap)
         if self._ckpt_writer is not None:
-            self._ckpt_writer.flush()
+            try:
+                self._ckpt_writer.flush()
+            except BaseException:
+                # the submitted epoch never became durable: roll the
+                # bookkeeping back so a retrying caller re-submits
+                # instead of trusting a phantom checkpoint
+                self._submitted_epoch = self._epoch_written()
+                raise
+        if snap is not None and snap[1] != self._epoch_written():
+            # earlier commit failed and its stored error was consumed by
+            # a previous interaction (the writer raises each error once):
+            # write the snapshot synchronously now — this either lands
+            # the bytes or raises, never leaves silence
+            self._submitted_epoch = self._epoch_written()
+            self._submit_snapshot(snap)
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.flush()
 
     def fit(self) -> float:
         cfg = self.config

@@ -11,9 +11,10 @@ async writer" section promises:
 - torn v3 is never restored: a missing/corrupt shard of a COMMITTED set
   is corruption (falls back through the candidate order), a shard set
   without its commit marker is invisible;
-- the writer keeps at most one pending save (newer supersedes), re-raises
-  background errors on the next trainer interaction, and leaves no
-  thread behind after fit().
+- the writer keeps at most one pending save per checkpoint file (newer
+  supersedes same-file only — a preemption save never displaces a queued
+  best save), re-raises background errors on the next trainer
+  interaction, and leaves no thread behind after fit().
 
 The multi-process sharded save/restore agreement lives in
 tests/test_multihost.py (gloo-safe paths only); the kill-mid-save drill
@@ -199,6 +200,39 @@ def test_async_writer_newer_save_supersedes_queued(tmp_path, lenet_state):
     )
 
 
+def test_async_writer_distinct_names_queue_independently(
+    tmp_path, lenet_state
+):
+    """The pending slot is per checkpoint NAME: a preemption last.msgpack
+    save submitted while a best ckpt.msgpack commit is still queued must
+    not displace it — both files land with their promised epochs (the
+    pre-fix single-slot queue silently dropped the queued best save and
+    left a phantom checkpoint)."""
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    w = AsyncCheckpointWriter(registry=reg)
+    out = str(tmp_path)
+    faults.inject("ckpt_write_stall", 200)
+    try:
+        # occupy the writer, then queue a best save and a preemption save
+        save_checkpoint(out, lenet_state, 3, 1.0, registry=reg, writer=w)
+        save_checkpoint(out, lenet_state, 4, 2.0, registry=reg, writer=w)
+        save_checkpoint(
+            out, lenet_state, 4, 2.0, name=LAST_NAME, registry=reg,
+            writer=w,
+        )
+        w.flush()
+    finally:
+        faults.clear("ckpt_write_stall")
+        w.close()
+    assert json.load(open(os.path.join(out, "ckpt.json")))["epoch"] == 4
+    assert json.load(open(os.path.join(out, "last.json")))["epoch"] == 4
+    # the best payload on disk is the epoch-4 publish, verified
+    meta = json.load(open(os.path.join(out, "ckpt.json")))
+    ckpt.read_verified_payload(out, "ckpt.msgpack", meta)
+
+
 def test_async_writer_error_reraised_on_next_interaction(
     tmp_path, lenet_state, monkeypatch
 ):
@@ -268,6 +302,70 @@ def test_trainer_async_save_no_thread_leak(tmp_path):
         for t in threading.enumerate()
     )
     assert os.path.isfile(os.path.join(cfg.output_dir, "ckpt.msgpack"))
+
+
+def test_trainer_flush_resubmits_after_failed_commit(
+    tmp_path, monkeypatch
+):
+    """A failed background commit whose stored error was already consumed
+    (the writer raises each error exactly once) must not leave a phantom
+    checkpoint: flush_checkpoints compares the snapshot against the
+    DURABLY-written epoch — advanced only by the commit's success
+    callback — and re-submits, so the best state still lands on disk."""
+    from pytorch_cifar_tpu.config import TrainConfig
+    from pytorch_cifar_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model="LeNet",
+        epochs=1,
+        batch_size=32,
+        eval_batch_size=32,
+        synthetic_data=True,
+        synthetic_train_size=64,
+        synthetic_test_size=32,
+        output_dir=str(tmp_path / "ckpt"),
+        amp=False,
+        log_every=1000,
+    )
+    tr = Trainer(cfg)
+    assert tr._ckpt_writer is not None
+    def failing_atomic_write(path, data):
+        raise RuntimeError("disk full (injected)")
+
+    monkeypatch.setattr(ckpt, "_atomic_write", failing_atomic_write)
+    tr.maybe_checkpoint(0, 50.0)  # snapshot + submit; the commit fails
+    with pytest.raises(RuntimeError, match="disk full"):
+        tr._ckpt_writer.flush()  # error surfaced and consumed here
+    monkeypatch.undo()
+    assert tr._epoch_written() is None  # nothing durable yet
+    tr.flush_checkpoints()  # must re-submit, not trust the phantom
+    tr._ckpt_writer.close()
+    assert tr._epoch_written() == 0
+    meta = json.load(open(os.path.join(cfg.output_dir, "ckpt.json")))
+    assert meta["epoch"] == 0
+    ckpt.read_verified_payload(cfg.output_dir, "ckpt.msgpack", meta)
+
+
+def test_multihost_sharded_save_commits_inline(
+    tmp_path, monkeypatch, lenet_state
+):
+    """Under multihost (mocked process_count=2) a sharded save must
+    ignore the async writer and commit on the calling thread: per-process
+    supersede decisions would let hosts publish different epoch
+    sequences and starve process 0's shard barrier. Mocked as the
+    NON-committing peer (process 1), which writes its shard and returns
+    without awaiting the barrier."""
+    out = str(tmp_path)
+    monkeypatch.setattr(ckpt.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(ckpt.jax, "process_index", lambda: 1)
+    w = AsyncCheckpointWriter()
+    save_checkpoint(out, lenet_state, 1, 1.0, writer=w)
+    # the shard is on disk already — no flush happened, so the commit ran
+    # inline and the writer never even started its thread
+    assert w._thread is None
+    sname = shard_name("ckpt.msgpack", 1, 2)
+    assert os.path.isfile(os.path.join(out, sname))
+    w.close()
 
 
 def test_trainer_rejects_invalid_async_save(tmp_path):

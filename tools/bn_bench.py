@@ -101,12 +101,12 @@ def main() -> int:
     from pytorch_cifar_tpu.models.common import bn_moments_impl
     from bench import run_one
 
-    stock = run_one("ResNet18", 8 if interpret else 512, steps, 5, jnp.bfloat16,
-                    repeats=repeats)
+    stock, _ = run_one("ResNet18", 8 if interpret else 512, steps, 5,
+                       jnp.bfloat16, repeats=repeats)
     with bn_moments_impl(lambda v: fused_moments(v, interpret)):
         # trace-time switch: run_one rebuilds + re-traces the step inside
-        fused = run_one("ResNet18", 8 if interpret else 512, steps, 5,
-                        jnp.bfloat16, repeats=repeats)
+        fused, _ = run_one("ResNet18", 8 if interpret else 512, steps, 5,
+                           jnp.bfloat16, repeats=repeats)
     print(
         f"ResNet18 train step  stock={stock:.0f} img/s  "
         f"fused-BN-moments={fused:.0f} img/s  ratio={fused / stock:.3f}"

@@ -52,7 +52,7 @@ def _bench_inline(names, args, results, flush_out):
     for name in names:
         t0 = time.perf_counter()
         try:
-            rate = run_one(
+            rate, _ = run_one(
                 name, args.batch, args.steps, args.warmup, jnp.bfloat16,
                 repeats=args.repeats,
             )
