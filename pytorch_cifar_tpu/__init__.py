@@ -286,6 +286,7 @@ def _harden_cache_writes() -> None:
                 pass
         tmp = self.path / f"{key}{cache_suffix}.tmp.{os.getpid()}"
         tmp.write_bytes(val)
+        # graftcheck: noqa[atomic-publish] -- compile-cache entry: the rename atomicity is what the SIGKILL drill demanded (no torn entry poisons later processes); a crash-lost entry just recompiles, so per-put fsync would tax every compile for nothing
         os.replace(tmp, cache_path)
         (self.path / f"{key}{atime_suffix}").write_bytes(
             time.time_ns().to_bytes(8, "little")

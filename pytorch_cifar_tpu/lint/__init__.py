@@ -9,7 +9,11 @@ so regressions are caught at review time; this package is that layer.
 
 - :mod:`pytorch_cifar_tpu.lint.engine` — the rule runner: file walking,
   inline suppressions (``# graftcheck: noqa[rule] -- reason``), baseline
-  matching, JSON/human output.
+  matching, JSON/human output, the shared one-parse-per-file AST cache.
+- :mod:`pytorch_cifar_tpu.lint.project` — the whole-project pass: import
+  graph, cross-module call graph, reachability views (hot paths, thread
+  entries, externally-traced closures), and the dp.py donation table
+  derived from dp.py's own AST.
 - :mod:`pytorch_cifar_tpu.lint.rules` — the rules themselves, each
   grounded in a failure mode this repo has actually hit (the catalog with
   one real-world example per rule is STATIC_ANALYSIS.md).
@@ -30,4 +34,5 @@ from pytorch_cifar_tpu.lint.engine import (  # noqa: F401
     match_baseline,
     write_baseline,
 )
+from pytorch_cifar_tpu.lint.project import ProjectGraph  # noqa: F401
 from pytorch_cifar_tpu.lint.rules import RULES, rule_names  # noqa: F401

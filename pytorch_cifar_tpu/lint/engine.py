@@ -88,14 +88,22 @@ class Finding:
 
 class ModuleCtx:
     """One parsed file handed to every rule: path, source, AST, comment
-    suppressions, and a lazy project-level view (config-field tables)."""
+    suppressions, and the lazy whole-project view (``self.project`` —
+    config-field tables, the import/call graph, reachability sets)."""
 
-    def __init__(self, path: str, relpath: str, source: str, project):
+    def __init__(self, path: str, relpath: str, source: str, project,
+                 tree: Optional[ast.Module] = None):
         self.path = path
         self.relpath = relpath
         self.source = source
         self.lines = source.splitlines()
-        self.tree = ast.parse(source, filename=path)
+        # the project AST cache guarantees ONE parse per file per run:
+        # rules walking ctx.tree and the project graph walking the same
+        # module see identical node objects (seed sets stay node sets)
+        self.tree = (
+            tree if tree is not None
+            else ast.parse(source, filename=path)
+        )
         self.project = project
         # line -> list of (frozenset of rule names or {"*"}, reason, raw)
         self.noqa: Dict[int, List[Tuple[frozenset, str]]] = {}
@@ -156,12 +164,56 @@ class ModuleCtx:
 
 
 class _Project:
-    """Lazy cross-file state shared by every ModuleCtx of one run (today:
-    the config-field tables the flag-config-drift rule checks against)."""
+    """Lazy cross-file state shared by every ModuleCtx of one run: the
+    config-field tables (flag-config-drift), the shared AST cache (one
+    parse per file per run), and the whole-project graph
+    (:mod:`pytorch_cifar_tpu.lint.project`) that backs the cross-module
+    rules."""
 
-    def __init__(self, repo_root: Optional[str]):
+    def __init__(self, repo_root: Optional[str], files: Sequence[str] = ()):
         self.repo_root = repo_root
+        self.files = [os.path.abspath(f) for f in files]
         self._config_fields: Optional[Dict[str, set]] = None
+        self._ast_cache: Dict[str, Tuple[str, ast.Module]] = {}
+        self._graph = None
+
+    def source_and_tree(self, path: str) -> Tuple[str, ast.Module]:
+        """Read + parse ``path`` once per run (raises OSError on a
+        missing file, SyntaxError on an unparseable one)."""
+        ap = os.path.abspath(path)
+        hit = self._ast_cache.get(ap)
+        if hit is not None:
+            return hit
+        with open(ap, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=ap)
+        self._ast_cache[ap] = (source, tree)
+        return source, tree
+
+    def graph(self):
+        """The whole-project import/call graph, built on first use over
+        this run's file set (plus on-demand external modules)."""
+        if self._graph is None:
+            from pytorch_cifar_tpu.lint.project import ProjectGraph
+
+            self._graph = ProjectGraph(
+                self.repo_root, self.files, self.source_and_tree
+            )
+        return self._graph
+
+    # -- rule-facing delegates (see project.ProjectGraph) --------------
+
+    def external_traced(self, path: str):
+        return self.graph().traced_seeds_for(path)
+
+    def hot_def_nodes(self, path: str):
+        return self.graph().hot_def_nodes(path)
+
+    def thread_reachable(self, path: str):
+        return self.graph().thread_reachable_for(path)
+
+    def donating_wrapper(self, path: str, qual: str):
+        return self.graph().resolve_donating_wrapper(path, qual)
 
     def config_fields(self) -> Dict[str, set]:
         """{'TrainConfig': {field/property names}, 'ServeConfig': {...}};
@@ -267,19 +319,22 @@ def lint_file(
     rules=None,
     relpath: Optional[str] = None,
     project=None,
+    stats: Optional[Dict[str, dict]] = None,
 ) -> List[Finding]:
     """Run ``rules`` (default: all) over one file; returns findings with
-    fingerprints computed and inline suppressions applied."""
+    fingerprints computed and inline suppressions applied. ``stats``
+    (optional dict) accumulates per-rule wall time and finding counts
+    across calls — the CLI's ``--stats`` view."""
+    import time
+
     from pytorch_cifar_tpu.lint.rules import RULES
 
     rules = RULES if rules is None else rules
     relpath = relpath or path
     if project is None:
-        project = _Project(_find_repo_root(path))
-    with open(path, encoding="utf-8") as f:
-        source = f.read()
+        project = _Project(_find_repo_root(path), files=[path])
     try:
-        ctx = ModuleCtx(path, relpath, source, project)
+        source, tree = project.source_and_tree(path)
     except SyntaxError as e:
         return [
             Finding(
@@ -287,9 +342,18 @@ def lint_file(
                 "file does not parse: %s" % e.msg,
             )
         ]
+    ctx = ModuleCtx(path, relpath, source, project, tree=tree)
     findings: List[Finding] = list(ctx.noqa_problems)
     for rule in rules:
-        for f in rule.check(ctx):
+        t0 = time.perf_counter()
+        rule_findings = list(rule.check(ctx))
+        if stats is not None:
+            s = stats.setdefault(
+                rule.name, {"seconds": 0.0, "findings": 0}
+            )
+            s["seconds"] += time.perf_counter() - t0
+            s["findings"] += len(rule_findings)
+        for f in rule_findings:
             f.path = relpath
             findings.append(f)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
@@ -308,6 +372,8 @@ def lint_file(
 class LintRun:
     findings: List[Finding]
     files: List[str]  # repo-relative paths of every file linted
+    stats: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    project: Optional[_Project] = None
 
 
 def lint_paths(
@@ -318,12 +384,14 @@ def lint_paths(
     """Lint every .py under ``paths``. Paths are reported relative to
     ``repo_root`` (default: auto-detected) when possible. Returns the
     findings plus the full linted-file list (a clean file produces no
-    findings but still anchors stale-baseline detection)."""
+    findings but still anchors stale-baseline detection), per-rule
+    timing stats, and the project handle (import graph access)."""
     files = collect_python_files(paths)
     root = repo_root or (_find_repo_root(files[0]) if files else None)
-    project = _Project(root)
+    project = _Project(root, files=files)
     findings: List[Finding] = []
     rels: List[str] = []
+    stats: Dict[str, dict] = {}
     for path in files:
         rel = path
         if root:
@@ -333,9 +401,12 @@ def lint_paths(
                 rel = path
         rels.append(rel)
         findings.extend(
-            lint_file(path, rules=rules, relpath=rel, project=project)
+            lint_file(
+                path, rules=rules, relpath=rel, project=project,
+                stats=stats,
+            )
         )
-    return LintRun(findings, rels)
+    return LintRun(findings, rels, stats, project)
 
 
 # -- baseline ----------------------------------------------------------
@@ -381,8 +452,13 @@ def write_baseline(path: str, findings: Iterable[Finding]) -> int:
     ]
     payload = json.dumps({"version": 1, "findings": entries}, indent=2)
     tmp = path + ".tmp.%d" % os.getpid()
+    # tmp+fsync+rename (the atomic-publish rule's own sanctioned shape):
+    # the baseline is checked in and hand-reviewed, so a crash must leave
+    # either the old complete file or the new complete file
     with open(tmp, "w", encoding="utf-8") as f:
         f.write(payload + "\n")
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return len(entries)
 

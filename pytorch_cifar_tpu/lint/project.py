@@ -1,0 +1,821 @@
+"""graftcheck whole-project analysis: import graph + cross-module call graph.
+
+PR 5's engine was deliberately single-module, and STATIC_ANALYSIS.md's
+"Known limits" named the escapes that bought: a closure traced in ANOTHER
+module, an aliased dp wrapper (``f = data_parallel_train_step``), a
+collective reachable only through a helper. This module closes them with
+one whole-tree pass:
+
+- every file is parsed ONCE per run (the engine's ``_Project`` AST cache
+  is shared, so a rule walking ``ctx.tree`` and the graph walking the
+  same module see the *same* node objects — seed sets are plain node
+  sets, no name matching);
+- ``import``/``from-import``/``as``-alias/re-export bindings are resolved
+  into an import graph (``to_json`` backs ``tools/lint.py --graph``,
+  ``reverse_dependents`` backs the graph-aware ``--changed``);
+- a cross-module call graph (``self.method``, local defs, imported
+  functions) feeds three reachability analyses rules consume through
+  ``ctx.project``: externally-traced closures (jit-impurity /
+  tracer-branch / prng-reuse), hot-path scoping from the trainer step
+  loop and engine dispatch (host-sync), and thread-entry reachability
+  (thread-collective);
+- the donation table is DERIVED from ``parallel/dp.py``'s own AST (the
+  ``jax.jit(..., donate_argnums=...)`` expression, including its
+  ``donate`` gate) instead of a hand-synced name table.
+
+Everything here is pure stdlib ``ast`` over source text — linted code is
+never imported. Resolution is deliberately conservative: an unresolvable
+binding simply contributes nothing (rules under-approximate rather than
+cry wolf). Modules imported from outside the linted file set (e.g. a
+fixture that imports ``pytorch_cifar_tpu.parallel``) are loaded on demand
+from the repo this lint package ships in, so fixtures see the real
+wrapper definitions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# entry points whose function-valued arguments get traced by jax
+TRACER_CALLS = {
+    "jax.jit", "jit",
+    "jax.vmap", "vmap",
+    "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "lax.scan",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.map", "lax.map",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+    "pl.pallas_call", "pallas_call",
+}
+TRACER_DECORATORS = {
+    "jax.jit", "jit", "jax.checkpoint", "jax.remat", "jax.vmap", "vmap",
+}
+
+# host-side cross-process collectives: every participant must arrive, so
+# calling one from a thread that makes its own local timing decisions can
+# strand the peers at the barrier (the thread-collective rule's set)
+HOST_COLLECTIVES = frozenset({
+    "broadcast_pytree", "broadcast_one_to_all", "process_allgather",
+    "allgather_merged", "sync_global_devices", "barrier",
+})
+
+# host-sync hot-path SEEDS: (path suffix, function basenames). Everything
+# CALLED from a seed — helpers included, across modules — becomes hot via
+# call-graph reachability, replacing PR 5's hand-maintained per-function
+# table (its blind spot: a sync hidden in a helper the table never named).
+HOT_SEEDS: Sequence[Tuple[str, frozenset]] = (
+    ("train/trainer.py",
+     frozenset({"fit", "train_epoch", "eval_epoch", "finish"})),
+    ("serve/engine.py", frozenset({"predict"})),
+    ("serve/batcher.py", frozenset({"_worker"})),
+)
+
+_THREAD_CTORS = ("threading.Thread", "Thread")
+
+# where the real package lives (this file is pytorch_cifar_tpu/lint/...):
+# the on-demand fallback root for imports of modules outside the linted set
+_LINT_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_DP_MODULE = "pytorch_cifar_tpu.parallel.dp"
+
+
+def qualname(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain ('jax.random.fold_in',
+    'self._lock'); None for anything not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_no_nested_funcs(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node``'s subtree but do not descend into nested function
+    definitions (they are analyzed as their own traced/untraced units)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, FuncNode + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+class ModuleInfo:
+    """One parsed module: name bindings + indexed function defs."""
+
+    def __init__(self, name: str, path: str, tree: ast.Module):
+        self.name = name          # dotted, graph-root-relative
+        self.path = path          # absolute
+        self.tree = tree
+        is_init = os.path.basename(path) == "__init__.py"
+        self.package = name if is_init else name.rpartition(".")[0]
+        # local name -> (dotted module target, symbol | None for modules)
+        self.import_bindings: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.raw_imports: Set[str] = set()   # every dotted import target
+        self.aliases: Dict[str, str] = {}    # module-level `f = g` chains
+        self.defs: Dict[str, ast.AST] = {}   # 'f' / 'Cls.m' / 'f.<locals>.g'
+        self.key_of: Dict[int, str] = {}     # id(def node) -> key
+        self.cls_of: Dict[int, Optional[str]] = {}  # id(def) -> class name
+        self._index()
+
+    # -- indexing ------------------------------------------------------
+
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.raw_imports.add(alias.name)
+                    if alias.asname:
+                        self.import_bindings[alias.asname] = (
+                            alias.name, None
+                        )
+                    else:
+                        first = alias.name.split(".", 1)[0]
+                        self.import_bindings.setdefault(first, (first, None))
+            elif isinstance(node, ast.ImportFrom):
+                target = self._from_target(node)
+                if target is None:
+                    continue
+                self.raw_imports.add(target)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    self.import_bindings[alias.asname or alias.name] = (
+                        target, alias.name
+                    )
+        for stmt in self.tree.body:  # module-level simple aliases only
+            if (
+                isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, (ast.Name, ast.Attribute))
+            ):
+                vq = qualname(stmt.value)
+                if vq is None:
+                    continue
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.aliases[tgt.id] = vq
+
+        def rec(owner: ast.AST, prefix: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(owner):
+                if isinstance(child, FuncNode):
+                    key = prefix + child.name
+                    self.defs[key] = child
+                    self.key_of[id(child)] = key
+                    self.cls_of[id(child)] = cls
+                    rec(child, key + ".<locals>.", cls)
+                elif isinstance(child, ast.ClassDef):
+                    rec(child, prefix + child.name + ".", child.name)
+                else:
+                    rec(child, prefix, cls)
+
+        rec(self.tree, "", None)
+
+    def _from_target(self, node: ast.ImportFrom) -> Optional[str]:
+        if not node.level:
+            return node.module
+        base = self.package.split(".") if self.package else []
+        drop = node.level - 1
+        if drop > len(base):
+            return None
+        base = base[: len(base) - drop]
+        if node.module:
+            base.append(node.module)
+        return ".".join(base) if base else None
+
+    def top_level_def(self, name: str) -> Optional[ast.AST]:
+        d = self.defs.get(name)
+        return d if d is not None and "." not in name else d
+
+
+class ProjectGraph:
+    """The whole-tree pass. Built lazily by the engine's ``_Project`` the
+    first time a rule asks; every analysis below is memoized."""
+
+    def __init__(self, root: Optional[str], files: Sequence[str], loader):
+        """``loader(path) -> (source, tree)`` is the shared AST cache
+        (may raise OSError/SyntaxError — such files are skipped)."""
+        self._loader = loader
+        files = [os.path.abspath(f) for f in files]
+        if root:
+            self.root = os.path.abspath(root)
+        elif files:
+            common = os.path.commonpath(files)
+            self.root = common if os.path.isdir(common) else (
+                os.path.dirname(common)
+            )
+        else:
+            self.root = os.getcwd()
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self._module_miss: Set[str] = set()
+        self._analyzed = False
+        for f in files:
+            self._add_file(f)
+
+    # -- module loading ------------------------------------------------
+
+    def _module_name(self, path: str) -> str:
+        try:
+            rel = os.path.relpath(path, self.root)
+        except ValueError:
+            rel = os.path.basename(path)
+        if rel.startswith(".."):
+            rel = os.path.basename(path)
+        name = rel[:-3] if rel.endswith(".py") else rel
+        name = name.replace(os.sep, ".").replace("/", ".")
+        if name.endswith(".__init__"):
+            name = name[: -len(".__init__")]
+        return name
+
+    def _add_file(self, path: str) -> Optional[ModuleInfo]:
+        path = os.path.abspath(path)
+        if path in self.by_path:
+            return self.by_path[path]
+        try:
+            _, tree = self._loader(path)
+        except (OSError, SyntaxError, ValueError):
+            return None
+        info = ModuleInfo(self._module_name(path), path, tree)
+        self.modules.setdefault(info.name, info)
+        self.by_path[path] = info
+        return info
+
+    def module_for_target(
+        self, dotted: str, external: bool = True
+    ) -> Optional[ModuleInfo]:
+        """The ModuleInfo a dotted import target refers to: exact graph
+        key first, then a unique-suffix match, then (``external``) an
+        on-demand load from the graph root or this lint package's repo."""
+        if not dotted:
+            return None
+        m = self.modules.get(dotted)
+        if m is not None:
+            return m
+        suffix = "." + dotted
+        cands = [k for k in self.modules if k.endswith(suffix)]
+        if len(cands) == 1:
+            return self.modules[cands[0]]
+        # the graph rooted BELOW the import's package (linting a subtree
+        # or a fixture mini-package): 'pkg.util' resolves to module 'util'
+        cands = [k for k in self.modules if dotted.endswith("." + k)]
+        if len(cands) == 1:
+            return self.modules[cands[0]]
+        if not external or dotted in self._module_miss:
+            return None
+        relparts = dotted.split(".")
+        for root in (self.root, _LINT_REPO_ROOT):
+            base = os.path.join(root, *relparts)
+            for cand in (base + ".py", os.path.join(base, "__init__.py")):
+                if os.path.isfile(cand):
+                    if cand in self.by_path:
+                        return self.by_path[cand]
+                    try:
+                        _, tree = self._loader(cand)
+                    except (OSError, SyntaxError, ValueError):
+                        continue
+                    info = ModuleInfo(dotted, cand, tree)
+                    self.modules.setdefault(dotted, info)
+                    self.by_path[cand] = info
+                    return info
+        self._module_miss.add(dotted)
+        return None
+
+    # -- name resolution -----------------------------------------------
+
+    def resolve(
+        self, m: ModuleInfo, qual: str, _depth: int = 0
+    ) -> Optional[Tuple[ModuleInfo, str, ast.AST]]:
+        """Resolve a dotted name as seen from module ``m`` to the
+        function def it ultimately binds — following module-level
+        aliases, import bindings, and re-export chains. Returns
+        (defining module, top-level def key, def node) or None."""
+        if _depth > 8 or not qual:
+            return None
+        head, _, rest = qual.partition(".")
+        if head in m.aliases and m.aliases[head] != qual:
+            target = m.aliases[head] + (("." + rest) if rest else "")
+            return self.resolve(m, target, _depth + 1)
+        if not rest:
+            d = m.defs.get(head)
+            if d is not None and "." not in head:
+                return (m, head, d)
+        if head in m.import_bindings:
+            mod, sym = m.import_bindings[head]
+            if sym is not None:
+                if rest:  # attribute access on an imported function
+                    return None
+                m2 = self.module_for_target(mod)
+                if m2 is None:
+                    return None
+                return self._resolve_symbol(m2, sym, _depth + 1)
+            return self._resolve_in_module_path(mod, rest, _depth + 1)
+        # plain dotted path that IS a module path (import a.b.c style)
+        if rest:
+            parts = qual.split(".")
+            for cut in range(len(parts) - 1, 0, -1):
+                if ".".join(parts[:cut]) in m.raw_imports:
+                    return self._resolve_in_module_path(
+                        ".".join(parts[:cut]),
+                        ".".join(parts[cut:]),
+                        _depth + 1,
+                    )
+        return None
+
+    def _resolve_in_module_path(
+        self, mod: str, rest: str, depth: int
+    ) -> Optional[Tuple[ModuleInfo, str, ast.AST]]:
+        if not rest:
+            return None
+        parts = rest.split(".")
+        while len(parts) > 1:  # descend submodules: pkg.sub.f
+            nxt = mod + "." + parts[0]
+            if self.module_for_target(nxt) is None:
+                break
+            mod, parts = nxt, parts[1:]
+        if len(parts) != 1:
+            return None
+        m2 = self.module_for_target(mod)
+        if m2 is None:
+            return None
+        return self._resolve_symbol(m2, parts[0], depth)
+
+    def _resolve_symbol(
+        self, m: ModuleInfo, sym: str, depth: int
+    ) -> Optional[Tuple[ModuleInfo, str, ast.AST]]:
+        if depth > 8:
+            return None
+        d = m.defs.get(sym)
+        if d is not None and "." not in sym:
+            return (m, sym, d)
+        if sym in m.aliases:
+            return self.resolve(m, m.aliases[sym], depth + 1)
+        if sym in m.import_bindings:  # re-export chain
+            mod, s2 = m.import_bindings[sym]
+            if s2 is None:
+                return None
+            m2 = self.module_for_target(mod)
+            if m2 is None:
+                return None
+            return self._resolve_symbol(m2, s2, depth + 1)
+        return None
+
+    # -- donation wrappers ---------------------------------------------
+
+    @staticmethod
+    def _positions_from(node: ast.AST) -> Optional[Tuple[int, ...]]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                if not (
+                    isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ):
+                    return None
+                out.append(e.value)
+            return tuple(out)
+        return None
+
+    @classmethod
+    def wrapper_info(cls, fdef: ast.AST) -> Optional[Tuple[Tuple[int, ...], Optional[str]]]:
+        """(donated positions, gate-parameter name) when ``fdef`` builds a
+        donating jit — i.e. its body contains ``jax.jit(...,
+        donate_argnums=X)`` where X is a literal, or ``LIT if gate else
+        ()`` with ``gate`` one of fdef's own parameters. This is how the
+        dp.py donation table is DERIVED instead of hand-synced: change
+        dp.py's donate_argnums and the rule follows automatically."""
+        if not isinstance(fdef, FuncNode):
+            return None
+        params = {
+            a.arg
+            for a in (
+                list(fdef.args.posonlyargs)
+                + list(fdef.args.args)
+                + list(fdef.args.kwonlyargs)
+            )
+        }
+        for node in walk_no_nested_funcs(fdef):
+            if not isinstance(node, ast.Call):
+                continue
+            if qualname(node.func) not in ("jax.jit", "jit"):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "donate_argnums":
+                    continue
+                v = kw.value
+                gate = None
+                pos = cls._positions_from(v)
+                if pos is None and isinstance(v, ast.IfExp):
+                    body = cls._positions_from(v.body)
+                    orelse = cls._positions_from(v.orelse)
+                    pos = body or orelse
+                    tq = qualname(v.test)
+                    if tq in params:
+                        gate = tq
+                if pos:
+                    return (pos, gate)
+        return None
+
+    def _dp_name_table(self) -> Dict[str, Tuple[Tuple[int, ...], Optional[str]]]:
+        """Fallback for unresolvable imports: the donating-wrapper table
+        derived from the REAL dp.py's AST, keyed by def name. Name-keyed
+        matching is the last resort (same reach as PR 6's hand table,
+        minus the hand-sync); resolution through the import graph is what
+        catches aliases and renames."""
+        if getattr(self, "_dp_table", None) is None:
+            self._dp_table = {}
+            m = self.module_for_target(_DP_MODULE)
+            if m is not None:
+                for key, d in m.defs.items():
+                    if "." in key:
+                        continue
+                    info = self.wrapper_info(d)
+                    if info:
+                        self._dp_table[key] = info
+        return self._dp_table
+
+    def resolve_donating_wrapper(
+        self, path: str, qual: str
+    ) -> Optional[Tuple[Tuple[int, ...], Optional[str]]]:
+        """Donation info for a call to ``qual`` as written in the module
+        at ``path``: (positions, gate param) or None."""
+        m = self.by_path.get(os.path.abspath(path))
+        if m is not None:
+            r = self.resolve(m, qual)
+            if r is not None:
+                info = self.wrapper_info(r[2])
+                if info:
+                    return info
+        return self._dp_name_table().get(qual.rsplit(".", 1)[-1])
+
+    # -- whole-tree analyses (traced seeds, call graph, threads) --------
+
+    def _analyze(self) -> None:
+        if self._analyzed:
+            return
+        self._analyzed = True
+        self._traced_seeds: Dict[str, Set[ast.AST]] = {}
+        self._edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self._node_of: Dict[Tuple[str, str], ast.AST] = {}
+        self._thread_entries: List[Tuple[str, str, str]] = []
+        self._tracer_wrapper_cache: Dict[int, bool] = {}
+        # snapshot: resolution may fault in external modules mid-loop
+        for m in list(self.by_path.values()):
+            self._analyze_module(m)
+
+    def _is_tracer_wrapper(self, fdef: ast.AST) -> bool:
+        """True when ``fdef`` passes one of its OWN parameters into a
+        TRACER_CALL (the dp.py wrapper shape: ``shard_map(step_fn, ...)``)
+        — calling it traces the callable you hand it."""
+        cached = self._tracer_wrapper_cache.get(id(fdef))
+        if cached is not None:
+            return cached
+        out = False
+        if isinstance(fdef, FuncNode):
+            params = {
+                a.arg
+                for a in (
+                    list(fdef.args.posonlyargs)
+                    + list(fdef.args.args)
+                    + list(fdef.args.kwonlyargs)
+                )
+            }
+            for node in walk_no_nested_funcs(fdef):
+                if isinstance(node, ast.Call) and (
+                    qualname(node.func) in TRACER_CALLS
+                ):
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Name) and arg.id in params:
+                            out = True
+        self._tracer_wrapper_cache[id(fdef)] = out
+        return out
+
+    @staticmethod
+    def _returned_local_defs(m: ModuleInfo, fkey: str) -> List[ast.AST]:
+        """Defs local to ``fkey`` that it returns (factory closures)."""
+        fdef = m.defs.get(fkey)
+        if fdef is None:
+            return []
+        out = []
+        for node in walk_no_nested_funcs(fdef):
+            if isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ):
+                d = m.defs.get(f"{fkey}.<locals>.{node.value.id}")
+                if d is not None:
+                    out.append(d)
+        return out
+
+    def _enclosing_key(self, m: ModuleInfo, parents, node) -> Optional[str]:
+        p = parents.get(node)
+        while p is not None and not isinstance(p, FuncNode):
+            p = parents.get(p)
+        return m.key_of.get(id(p)) if p is not None else None
+
+    def _local_def(self, m: ModuleInfo, scope_key: Optional[str], name: str):
+        """The def ``name`` visible from inside ``scope_key``: nearest
+        enclosing ``<locals>`` scope, else a top-level def."""
+        key = scope_key
+        while key:
+            d = m.defs.get(f"{key}.<locals>.{name}")
+            if d is not None:
+                return d, f"{key}.<locals>.{name}"
+            key = key.rpartition(".<locals>.")[0] if ".<locals>." in key else ""
+        d = m.defs.get(name)
+        if d is not None and "." not in name:
+            return d, name
+        return None, None
+
+    def _resolve_callable(
+        self, m: ModuleInfo, parents, call_node, func_expr
+    ) -> Optional[Tuple[ModuleInfo, str, ast.AST]]:
+        """Where a call/reference lands: self.method, lexically visible
+        local def, module def, or an import-resolved def elsewhere."""
+        q = qualname(func_expr)
+        if q is None:
+            return None
+        scope_key = self._enclosing_key(m, parents, call_node)
+        if q.startswith("self."):
+            rest = q.split(".", 1)[1]
+            if "." in rest:
+                return None  # self.obj.method: type unknown
+            scope = scope_key or ""
+            cls = None
+            d = m.defs.get(scope) if scope else None
+            if d is not None:
+                cls = m.cls_of.get(id(d))
+            if cls:
+                mk = f"{cls}.{rest}"
+                md = m.defs.get(mk)
+                if md is not None:
+                    return (m, mk, md)
+            return None
+        if "." not in q:
+            d, key = self._local_def(m, scope_key, q)
+            if d is not None:
+                return (m, key, d)
+        return self.resolve(m, q)
+
+    def _resolve_value(
+        self, m: ModuleInfo, parents, at_node, expr, _depth=0
+    ):
+        """What a Name/Attribute ARGUMENT refers to, following simple
+        function-local assignment chains: returns ('def', resolved) for a
+        direct function reference or ('factory', resolved) when the value
+        is the RESULT of calling a resolved function."""
+        if _depth > 5 or not isinstance(expr, (ast.Name, ast.Attribute)):
+            return None
+        direct = self._resolve_callable(m, parents, at_node, expr)
+        if direct is not None:
+            return ("def", direct)
+        if not isinstance(expr, ast.Name):
+            return None
+        # function-local `x = factory(...)` / `x = other_name`
+        scope_key = self._enclosing_key(m, parents, at_node)
+        scope = m.defs.get(scope_key) if scope_key else m.tree
+        if scope is None:
+            scope = m.tree
+        for node in walk_no_nested_funcs(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == expr.id
+                for t in node.targets
+            ):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                r = self._resolve_callable(m, parents, node, v.func)
+                if r is not None:
+                    return ("factory", r)
+            elif isinstance(v, (ast.Name, ast.Attribute)):
+                return self._resolve_value(
+                    m, parents, node, v, _depth + 1
+                )
+        return None
+
+    def _analyze_module(self, m: ModuleInfo) -> None:
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        # call-graph edges + thread entries + external-trace seeds
+        for key, d in m.defs.items():
+            nk = (m.path, key)
+            self._node_of[nk] = d
+            edges = self._edges.setdefault(nk, set())
+            for node in walk_no_nested_funcs(d):
+                if not isinstance(node, ast.Call):
+                    continue
+                r = self._resolve_callable(m, parents, node, node.func)
+                if r is not None:
+                    m2, k2, d2 = r
+                    self._node_of[(m2.path, k2)] = d2
+                    edges.add((m2.path, k2))
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualname(node.func)
+            if q in _THREAD_CTORS:
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    r = self._resolve_value(m, parents, node, kw.value)
+                    if r is not None and r[0] == "def":
+                        m2, k2, d2 = r[1]
+                        self._node_of[(m2.path, k2)] = d2
+                        self._thread_entries.append(
+                            (m2.path, k2, f"{m.name}:{k2}")
+                        )
+                continue
+            # tracer call (jax.jit/scan/... or a resolved tracer wrapper
+            # like the dp jits): its callable arguments are traced, even
+            # when they live in another module
+            is_tracer = q in TRACER_CALLS
+            if not is_tracer and q is not None:
+                r = self._resolve_callable(m, parents, node, node.func)
+                if r is not None and self._is_tracer_wrapper(r[2]):
+                    is_tracer = True
+            if not is_tracer:
+                continue
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                rv = self._resolve_value(m, parents, node, arg)
+                if rv is None:
+                    continue
+                kind, (m2, k2, d2) = rv
+                if kind == "def":
+                    if isinstance(d2, FuncNode):
+                        self._traced_seeds.setdefault(
+                            m2.path, set()
+                        ).add(d2)
+                else:  # factory result: its returned closures trace
+                    for inner in self._returned_local_defs(m2, k2):
+                        self._traced_seeds.setdefault(
+                            m2.path, set()
+                        ).add(inner)
+
+    def _closure(self, seeds: Set[Tuple[str, str]]) -> Set[Tuple[str, str]]:
+        self._analyze()
+        seen = set(seeds)
+        work = list(seeds)
+        while work:
+            nk = work.pop()
+            for nxt in self._edges.get(nk, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append(nxt)
+        return seen
+
+    # -- rule-facing API -----------------------------------------------
+
+    def traced_seeds_for(self, path: str) -> Set[ast.AST]:
+        """Defs in the module at ``path`` that some OTHER call site (any
+        module) hands to a tracer — union these into the per-module
+        traced_functions fixpoint."""
+        self._analyze()
+        return self._traced_seeds.get(os.path.abspath(path), set())
+
+    def hot_def_nodes(self, path: str) -> Set[ast.AST]:
+        """Defs in ``path`` on a hot path: reachable from the trainer
+        step loop / engine dispatch / batcher worker seeds (HOT_SEEDS)
+        through the cross-module call graph."""
+        self._analyze()
+        if getattr(self, "_hot", None) is None:
+            seeds = set()
+            for m in list(self.by_path.values()):
+                p = m.path.replace(os.sep, "/")
+                for suffix, names in HOT_SEEDS:
+                    if not p.endswith(suffix):
+                        continue
+                    for key, d in m.defs.items():
+                        if key.split(".")[-1] in names:
+                            seeds.add((m.path, key))
+            self._hot = self._closure(seeds)
+        ap = os.path.abspath(path)
+        return {
+            self._node_of[nk] for nk in self._hot
+            if nk[0] == ap and nk in self._node_of
+        }
+
+    def thread_reachable_for(self, path: str) -> Dict[ast.AST, str]:
+        """{def node in ``path``: thread-entry label} for every def
+        reachable from a ``Thread(target=...)`` entry anywhere in the
+        linted tree."""
+        self._analyze()
+        if getattr(self, "_thread_reach", None) is None:
+            reach: Dict[Tuple[str, str], str] = {}
+            for epath, ekey, label in self._thread_entries:
+                for nk in self._closure({(epath, ekey)}):
+                    reach.setdefault(nk, label)
+            self._thread_reach = reach
+        ap = os.path.abspath(path)
+        return {
+            self._node_of[nk]: label
+            for nk, label in self._thread_reach.items()
+            if nk[0] == ap and nk in self._node_of
+        }
+
+    # -- import graph (CLI: --graph, graph-aware --changed) -------------
+
+    def _import_edges(self) -> Dict[str, Set[str]]:
+        """module name -> imported module names, restricted to modules in
+        the linted set (external deps like jax are not edges)."""
+        if getattr(self, "_imports", None) is None:
+            linted = {m.path for m in self.by_path.values()}
+            out: Dict[str, Set[str]] = {}
+            for m in list(self.by_path.values()):
+                deps: Set[str] = set()
+                for target in sorted(m.raw_imports):
+                    t = self.module_for_target(target, external=False)
+                    if t is None:
+                        # `from pkg.mod import f` resolved as pkg/__init__?
+                        # also try the parent package for dotted targets
+                        t = self.module_for_target(
+                            target.rpartition(".")[0], external=False
+                        )
+                    if t is not None and t.path in linted and (
+                        t.path != m.path
+                    ):
+                        deps.add(t.name)
+                # a `from pkg import name` binding may reach THROUGH the
+                # package __init__ into a submodule: count the submodule
+                for mod, sym in m.import_bindings.values():
+                    if sym is None:
+                        continue
+                    r = self._resolve_symbol_module(mod, sym)
+                    if r is not None and r.path in linted and (
+                        r.path != m.path
+                    ):
+                        deps.add(r.name)
+                out[m.name] = deps
+            self._imports = out
+        return self._imports
+
+    def _resolve_symbol_module(
+        self, mod: str, sym: str
+    ) -> Optional[ModuleInfo]:
+        m2 = self.module_for_target(mod, external=False)
+        if m2 is None:
+            return None
+        r = self._resolve_symbol(m2, sym, 0)
+        return r[0] if r is not None else m2
+
+    def to_json(self) -> dict:
+        edges = self._import_edges()
+        mods = {}
+        for name in sorted(edges):
+            m = self.modules.get(name)
+            if m is None:
+                continue
+            try:
+                rel = os.path.relpath(m.path, self.root)
+            except ValueError:
+                rel = m.path
+            mods[name] = {
+                "path": rel.replace(os.sep, "/"),
+                "imports": sorted(edges[name]),
+            }
+        return {"version": 1, "root": self.root, "modules": mods}
+
+    def reverse_dependents(self, changed_paths: Sequence[str]) -> List[str]:
+        """Paths of linted modules whose import closure reaches any of
+        ``changed_paths`` — the files a change can break at a distance
+        (what ``--changed`` must re-lint along with the change itself)."""
+        changed = {os.path.abspath(p) for p in changed_paths}
+        changed_names = {
+            m.name for m in self.by_path.values() if m.path in changed
+        }
+        if not changed_names:
+            return []
+        edges = self._import_edges()
+        # reverse closure: importer -> ... -> changed
+        rev: Dict[str, Set[str]] = {}
+        for src, deps in edges.items():
+            for dep in deps:
+                rev.setdefault(dep, set()).add(src)
+        hit: Set[str] = set()
+        work = list(changed_names)
+        while work:
+            name = work.pop()
+            for importer in rev.get(name, ()):
+                if importer not in hit and importer not in changed_names:
+                    hit.add(importer)
+                    work.append(importer)
+        return sorted(
+            self.modules[n].path for n in hit if n in self.modules
+        )

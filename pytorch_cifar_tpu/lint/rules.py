@@ -1,18 +1,27 @@
-"""graftcheck rules: 8 JAX/concurrency invariants this repo has bled for.
+"""graftcheck rules: 11 JAX/concurrency invariants this repo has bled for.
 
 Every rule is grounded in a failure mode from this repo's own history
 (STATIC_ANALYSIS.md has the catalog with one real-world example each).
 Rules are deliberately CONSERVATIVE: a lint that cries wolf gets turned
-off, so each detector only fires on patterns it can resolve statically
-within one module — the fixture tests in tests/test_lint.py pin both the
-positive (fires) and negative (stays quiet) cases for each rule.
+off, so each detector only fires on patterns it can resolve statically —
+the fixture tests in tests/test_lint.py pin both the positive (fires)
+and negative (stays quiet) cases for each rule.
+
+Since PR 8 the rules see the WHOLE linted tree, not one module at a
+time: ``ctx.project`` carries an import graph and a cross-module call
+graph (:mod:`pytorch_cifar_tpu.lint.project`), so traced closures are
+followed across module boundaries, the dp.py donation table is derived
+from dp.py's own AST (aliases included), host-sync hot paths are scoped
+by reachability from the trainer step loop / engine dispatch, and
+thread-entry reachability backs the thread-collective rule.
 
 Shared analyses:
 
 - :func:`traced_functions` — which function defs end up inside a jax
   trace (jit/scan/vmap/grad/pallas_call/AOT ``.lower``, decorators,
-  ``make_*_step``/``make_*_epoch`` factory returns, lexical nesting, and
-  one same-module call-graph fixpoint).
+  ``make_*_step``/``make_*_epoch`` factory returns, lexical nesting, one
+  same-module call-graph fixpoint, plus the project graph's
+  externally-traced seeds).
 - :func:`qualname` — dotted-name resolution for Name/Attribute chains.
 """
 
@@ -20,54 +29,18 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pytorch_cifar_tpu.lint.engine import Finding, ModuleCtx
+from pytorch_cifar_tpu.lint.project import (  # noqa: F401  (re-exported)
+    HOST_COLLECTIVES,
+    TRACER_CALLS,
+    TRACER_DECORATORS,
+    FuncNode,
+    qualname,
+    walk_no_nested_funcs,
+)
 
-FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
-
-
-def qualname(node: ast.AST) -> Optional[str]:
-    """Dotted name of a Name/Attribute chain ('jax.random.fold_in',
-    'self._lock'); None for anything not a plain chain."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def walk_no_nested_funcs(node: ast.AST) -> Iterator[ast.AST]:
-    """Walk ``node``'s subtree but do not descend into nested function
-    definitions (they are analyzed as their own traced/untraced units)."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        child = stack.pop()
-        yield child
-        if not isinstance(child, FuncNode + (ast.Lambda,)):
-            stack.extend(ast.iter_child_nodes(child))
-
-
-# entry points whose function-valued arguments get traced by jax
-TRACER_CALLS = {
-    "jax.jit", "jit",
-    "jax.vmap", "vmap",
-    "jax.grad", "jax.value_and_grad",
-    "jax.checkpoint", "jax.remat",
-    "jax.lax.scan", "lax.scan",
-    "jax.lax.cond", "lax.cond",
-    "jax.lax.while_loop", "lax.while_loop",
-    "jax.lax.fori_loop", "lax.fori_loop",
-    "jax.lax.map", "lax.map",
-    "shard_map", "jax.experimental.shard_map.shard_map",
-    "pl.pallas_call", "pallas_call",
-}
-TRACER_DECORATORS = {
-    "jax.jit", "jit", "jax.checkpoint", "jax.remat", "jax.vmap", "vmap",
-}
 _FACTORY_RE = re.compile(r"^make_\w*?(step|epoch|fn)\w*$")
 
 
@@ -91,8 +64,11 @@ def traced_functions(ctx: ModuleCtx) -> Set[ast.AST]:
     Seeds: tracer decorators; function names (or ``self.X`` aliases of
     local defs) passed to TRACER_CALLS / ``jax.jit(...).lower``; defs
     RETURNED from a ``make_*step``/``make_*epoch`` factory (this repo's
-    convention for step closures that the trainer jits later). Closure:
-    defs lexically nested in a traced def, and same-module defs called by
+    convention for step closures that the trainer jits later); and the
+    project graph's externally-traced seeds — defs of THIS module that
+    some other module hands to a tracer (directly, via a re-export, or
+    as a factory whose returned closure gets jitted). Closure: defs
+    lexically nested in a traced def, and same-module defs called by
     name from a traced body (one fixpoint)."""
     tree = ctx.tree
     defs_by_name: Dict[str, List[ast.AST]] = {}
@@ -145,7 +121,7 @@ def traced_functions(ctx: ModuleCtx) -> Set[ast.AST]:
                     if d is not None:
                         self_alias[q] = d
 
-    traced: Set[ast.AST] = set()
+    traced: Set[ast.AST] = set(ctx.project.external_traced(ctx.path))
 
     def seed(fn_expr: ast.AST, at: ast.AST) -> None:
         if isinstance(fn_expr, ast.Lambda):
@@ -633,25 +609,6 @@ class TracerBranch(Rule):
 # 4. host-sync
 # ---------------------------------------------------------------------
 
-# (path suffix, hot function names): the trainer step loop and the
-# serving dispatch path — the two places a hidden device sync stalls
-# the pipeline for every caller
-_HOT_FUNCTIONS: Sequence[Tuple[str, frozenset]] = (
-    (
-        "train/trainer.py",
-        frozenset({
-            "train_epoch", "eval_epoch", "_train_epoch_compiled",
-            "_dispatch_train_epoch", "_dispatch_eval_epoch",
-            "_timed_batches", "fit", "finish",
-        }),
-    ),
-    (
-        "serve/engine.py",
-        frozenset({"predict", "_run_bucket", "_put_batch"}),
-    ),
-    ("serve/batcher.py", frozenset({"_worker", "_take_batch"})),
-)
-
 _DEVICE_CALL_ATTRS = frozenset({
     "train_step", "eval_step", "train_epoch_fn", "eval_epoch_fn",
 })
@@ -664,27 +621,18 @@ _HOST_FETCHERS = frozenset({
 class HostSync(Rule):
     name = "host-sync"
     summary = (
-        ".item()/float()/np.asarray() on a jax array inside the trainer "
-        "step loop or engine dispatch path — a hidden blocking D2H sync "
-        "that stalls dispatch run-ahead (the reference's per-step "
-        ".item() trap)"
+        ".item()/float()/np.asarray() on a jax array on a hot path — "
+        "any function reachable from the trainer step loop or engine "
+        "dispatch (project call-graph reachability, seeds in "
+        "project.HOT_SEEDS) — a hidden blocking D2H sync that stalls "
+        "dispatch run-ahead (the reference's per-step .item() trap)"
     )
 
-    def _hot_names(self, ctx: ModuleCtx) -> Optional[frozenset]:
-        path = ctx.relpath.replace("\\", "/")
-        for suffix, names in _HOT_FUNCTIONS:
-            if path.endswith(suffix):
-                return names
-        return None
-
     def check(self, ctx: ModuleCtx) -> List[Finding]:
-        hot = self._hot_names(ctx)
-        if hot is None:
-            return []
         out = []
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, FuncNode) and node.name in hot:
-                out.extend(self._check_fn(ctx, node))
+        for fn in ctx.project.hot_def_nodes(ctx.path):
+            if isinstance(fn, FuncNode):
+                out.extend(self._check_fn(ctx, fn))
         return out
 
     @staticmethod
@@ -785,26 +733,15 @@ class HostSync(Rule):
 # 5. donation-misuse
 # ---------------------------------------------------------------------
 
-# Donation THROUGH the data-parallel wrapper jits (parallel/dp.py) — the
-# rule's former blind spot: `step = data_parallel_train_step(fn, mesh)`
-# produces a callable that donates these positions unless built with
-# donate=False. The table mirrors dp.py's donate_argnums — a position
-# change there must land here in the same PR (pinned by the dp.py
-# docstrings and tests/test_lint.py fixtures; STATIC_ANALYSIS.md).
-_WRAPPER_DONATIONS = {
-    "data_parallel_train_step": (0, 1),   # state, (images, labels)
-    "data_parallel_train_epoch": (0, 1, 4),  # state, totals, perm
-}
-
-
 class DonationMisuse(Rule):
     name = "donation-misuse"
     summary = (
-        "an argument donated via donate_argnums — or through a dp.py "
-        "wrapper jit (data_parallel_train_step/epoch) — is read again "
-        "after the jitted call: the buffer was handed to XLA and may "
-        "already hold the output (garbage reads, or the "
-        "donate-same-buffer abort)"
+        "an argument donated via donate_argnums — or through a donating "
+        "wrapper jit like dp.py's data_parallel_train_step/epoch, "
+        "resolved from the wrapper's OWN AST through the import graph "
+        "(aliases included) — is read again after the jitted call: the "
+        "buffer was handed to XLA and may already hold the output "
+        "(garbage reads, or the donate-same-buffer abort)"
     )
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
@@ -815,7 +752,27 @@ class DonationMisuse(Rule):
         return out
 
     @staticmethod
-    def _donated_positions(call: ast.Call) -> Optional[List[int]]:
+    def _wrapper_for(
+        ctx: ModuleCtx, qual: Optional[str], local_alias: Dict[str, str]
+    ):
+        """Donation info for a call target: (positions, gate param) or
+        None. Follows function-local aliases (``f = wrapper; step =
+        f(...)``) before resolving through the project graph — which
+        itself follows module aliases, imports, and re-exports down to
+        the wrapper def's ``jax.jit(..., donate_argnums=...)``."""
+        if not qual:
+            return None
+        for _ in range(4):  # bounded local alias chain
+            nxt = local_alias.get(qual)
+            if nxt is None or nxt == qual:
+                break
+            qual = nxt
+        return ctx.project.donating_wrapper(ctx.path, qual)
+
+    @classmethod
+    def _donated_positions(
+        cls, ctx: ModuleCtx, call: ast.Call, local_alias: Dict[str, str]
+    ) -> Optional[List[int]]:
         q = qualname(call.func)
         if q in ("jax.jit", "jit"):
             for kw in call.keywords:
@@ -833,25 +790,40 @@ class DonationMisuse(Rule):
                             pos.append(e.value)
                     return pos
             return None
-        # dp.py wrapper jits: donate by default; an explicit donate=False
-        # turns it off (any other value — a variable, True — keeps the
-        # conservative default: donated)
-        wrapped = _WRAPPER_DONATIONS.get((q or "").rsplit(".", 1)[-1])
-        if wrapped is not None:
+        # donating wrapper jits (dp.py's data_parallel_*): positions and
+        # the gate parameter come from the wrapper's own AST. The gate
+        # (donate=False) turns donation off; any other value — a
+        # variable, True — keeps the conservative default: donated.
+        info = cls._wrapper_for(ctx, q, local_alias)
+        if info is not None:
+            positions, gate = info
             for kw in call.keywords:
                 if (
-                    kw.arg == "donate"
+                    gate is not None
+                    and kw.arg == gate
                     and isinstance(kw.value, ast.Constant)
                     and kw.value.value is False
                 ):
                     return None
-            return list(wrapped)
+            return list(positions)
         return None
 
     def _check_fn(self, ctx: ModuleCtx, fn) -> List[Finding]:
         donating: Dict[str, List[int]] = {}
         out: List[Finding] = []
         seen_sites: Set[Tuple[int, int, str]] = set()
+        # function-local wrapper aliases: `f = data_parallel_train_step`
+        local_alias: Dict[str, str] = {}
+        for node in walk_no_nested_funcs(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Name, ast.Attribute)
+            ):
+                vq = qualname(node.value)
+                if vq is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local_alias[tgt.id] = vq
 
         def scan_block(stmts):
             for i, stmt in enumerate(stmts):
@@ -859,7 +831,9 @@ class DonationMisuse(Rule):
                 if isinstance(stmt, ast.Assign) and isinstance(
                     stmt.value, ast.Call
                 ):
-                    pos = self._donated_positions(stmt.value)
+                    pos = self._donated_positions(
+                        ctx, stmt.value, local_alias
+                    )
                     if pos is not None:
                         for tgt in stmt.targets:
                             if isinstance(tgt, ast.Name):
@@ -1456,6 +1430,369 @@ def parse_own_config(ctx: ModuleCtx) -> Dict[str, set]:
     return parse_config_fields_from_tree(ctx.tree)
 
 
+# ---------------------------------------------------------------------
+# 9. thread-collective
+# ---------------------------------------------------------------------
+
+
+class ThreadCollective(Rule):
+    name = "thread-collective"
+    summary = (
+        "a host collective (broadcast_pytree / process_allgather / "
+        "barrier ...) is reachable from a Thread(target=...) entry — a "
+        "background thread makes per-process timing decisions, so its "
+        "collective can strand every peer at the barrier (the async "
+        "checkpoint writer's multihost supersede bug shape)"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        reach = ctx.project.thread_reachable(ctx.path)
+        out = []
+        for fn, entry in reach.items():
+            if not isinstance(fn, FuncNode):
+                continue
+            for node in walk_no_nested_funcs(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qualname(node.func)
+                if q and q.rsplit(".", 1)[-1] in HOST_COLLECTIVES:
+                    out.append(
+                        self.finding(
+                            ctx, node,
+                            "%s() is reachable from thread entry %s — a "
+                            "collective on a background thread decides "
+                            "its own timing per process, so peers can "
+                            "be left waiting at the barrier forever; "
+                            "run collectives on the main thread (the "
+                            "sharded checkpoint publish uses a "
+                            "FILESYSTEM barrier for exactly this "
+                            "reason)" % (q, entry),
+                        )
+                    )
+        return out
+
+
+# ---------------------------------------------------------------------
+# 10. atomic-publish
+# ---------------------------------------------------------------------
+
+_RENAME_FNS = ("os.replace", "os.rename")
+
+
+class AtomicPublish(Rule):
+    name = "atomic-publish"
+    summary = (
+        "a file that is later the SOURCE of an os.replace/os.rename was "
+        "written without an fsync (tmp+rename without the fsync is "
+        "atomic for readers but NOT durable: the journal can commit the "
+        "rename before the data blocks, leaving a complete-looking "
+        "empty file after a crash), or a commit-marker sidecar is "
+        "written before its payload — route publishes through the "
+        "sanctioned tmp+fsync+rename helpers (checkpoint._atomic_write)"
+    )
+
+    @staticmethod
+    def _write_key(expr: ast.AST) -> str:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return ast.dump(expr)
+
+    @classmethod
+    def _written_paths(cls, fn) -> Dict[str, ast.AST]:
+        """Path-expression keys this function writes inline: open(p,'w'),
+        p.write_bytes()/write_text(), shutil.copyfile(src, p)."""
+        out: Dict[str, ast.AST] = {}
+        for node in walk_no_nested_funcs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualname(node.func)
+            if q == "open" and len(node.args) >= 2:
+                mode = node.args[1]
+                if isinstance(mode, ast.Constant) and isinstance(
+                    mode.value, str
+                ) and ("w" in mode.value or "a" in mode.value):
+                    out[cls._write_key(node.args[0])] = node
+            elif q and q.rsplit(".", 1)[-1] in (
+                "write_bytes", "write_text"
+            ) and isinstance(node.func, ast.Attribute):
+                out[cls._write_key(node.func.value)] = node
+            elif q in ("shutil.copyfile", "shutil.copy") and (
+                len(node.args) >= 2
+            ):
+                out[cls._write_key(node.args[1])] = node
+        return out
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, FuncNode):
+                out.extend(self._check_rename(ctx, fn))
+                out.extend(self._check_marker_order(ctx, fn))
+        return out
+
+    def _check_rename(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        written = self._written_paths(fn)
+        if not written:
+            return []
+        has_fsync = any(
+            isinstance(n, ast.Call)
+            and (qualname(n.func) or "").rsplit(".", 1)[-1] == "fsync"
+            for n in walk_no_nested_funcs(fn)
+        )
+        if has_fsync:
+            return []
+        out = []
+        for node in walk_no_nested_funcs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if qualname(node.func) not in _RENAME_FNS or not node.args:
+                continue
+            src = self._write_key(node.args[0])
+            if src in written:
+                out.append(
+                    self.finding(
+                        ctx, node,
+                        "%r is renamed into place but was written with "
+                        "no fsync — the rename can hit the journal "
+                        "before the data blocks do, publishing a "
+                        "complete-looking empty/torn file after a "
+                        "crash; use the tmp+fsync+rename shape "
+                        "(train/checkpoint._atomic_write)" % src,
+                    )
+                )
+        return out
+
+    def _check_marker_order(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        """Within one publish function, a commit-marker write —
+        ``<helper>(meta_path(D, N), ...)`` — must come AFTER the payload
+        write for the same (D, N) (``os.path.join(D, N)``): a reader
+        trusts whatever the marker describes, so a marker published
+        first describes bytes that are not on disk yet."""
+        # resolve simple local names to their assigned expression once
+        assigned: Dict[str, ast.AST] = {}
+        for node in walk_no_nested_funcs(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    assigned[tgt.id] = node.value
+
+        def path_expr(e: ast.AST) -> ast.AST:
+            if isinstance(e, ast.Name) and e.id in assigned:
+                return assigned[e.id]
+            return e
+
+        markers: List[Tuple[int, str, ast.AST]] = []
+        payloads: List[Tuple[int, str]] = []
+        for node in walk_no_nested_funcs(fn):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            q = qualname(node.func) or ""
+            if q.rsplit(".", 1)[-1] not in (
+                "_atomic_write", "atomic_write",
+            ):
+                continue
+            p = path_expr(node.args[0])
+            if not (isinstance(p, ast.Call) and len(p.args) >= 2):
+                continue
+            pq = qualname(p.func) or ""
+            key = "%s|%s" % (
+                ast.dump(p.args[0]), ast.dump(p.args[1])
+            )
+            if pq.rsplit(".", 1)[-1] == "meta_path":
+                markers.append((node.lineno, key, node))
+            elif pq in ("os.path.join", "path.join"):
+                payloads.append((node.lineno, key))
+        out = []
+        for mline, mkey, mnode in markers:
+            later_payload = [
+                pl for pl, pkey in payloads if pkey == mkey and pl > mline
+            ]
+            if later_payload:
+                out.append(
+                    self.finding(
+                        ctx, mnode,
+                        "commit marker (meta_path sidecar) is written "
+                        "BEFORE its payload — a reader that trusts the "
+                        "marker can see a commit describing bytes not "
+                        "yet on disk; the marker must be the LAST "
+                        "publish step (format v3's torn-publish "
+                        "invisibility depends on it)",
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------
+# 11. thread-join
+# ---------------------------------------------------------------------
+
+
+class ThreadJoin(Rule):
+    name = "thread-join"
+    summary = (
+        "a started Thread with no join() on any exit path — a leaked "
+        "worker outlives its owner (shutdown hangs, interleaved "
+        "teardown writes); every PR 6-7 thread owner had to pin "
+        "no-thread-leak by hand, this rule makes it a checked invariant"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, FuncNode):
+                out.extend(self._check_local(ctx, fn))
+        return out
+
+    @staticmethod
+    def _is_thread_ctor(call: ast.AST) -> bool:
+        return isinstance(call, ast.Call) and qualname(call.func) in (
+            "threading.Thread", "Thread",
+        )
+
+    def _check_class(self, ctx: ModuleCtx, cls: ast.ClassDef):
+        """Thread handles stored on self must be joined by SOME method
+        (directly or via a ``t = self._thread; t.join()`` alias)."""
+        thread_attrs: Dict[str, ast.AST] = {}  # attr -> ctor node
+        joined: Set[str] = set()
+        started: Set[str] = set()
+        for m in (n for n in cls.body if isinstance(n, FuncNode)):
+            local_threads: Set[str] = set()
+            attr_alias: Dict[str, str] = {}  # local name -> self attr
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and self._is_thread_ctor(
+                    node.value
+                ):
+                    for tgt in node.targets:
+                        tq = qualname(tgt)
+                        if tq and tq.startswith("self."):
+                            thread_attrs.setdefault(
+                                tq.split(".", 1)[1], node.value
+                            )
+                        elif isinstance(tgt, ast.Name):
+                            local_threads.add(tgt.id)
+                elif isinstance(node, ast.Assign):
+                    vq = qualname(node.value)
+                    for tgt in node.targets:
+                        tq2 = qualname(tgt)
+                        if isinstance(tgt, ast.Name):
+                            if vq and vq.startswith("self."):
+                                attr_alias[tgt.id] = vq.split(".", 1)[1]
+                            elif isinstance(
+                                node.value, ast.Name
+                            ) and node.value.id in local_threads:
+                                local_threads.add(tgt.id)
+                        elif tq2 and tq2.startswith("self.") and (
+                            isinstance(node.value, ast.Name)
+                            and node.value.id in local_threads
+                        ):
+                            # t = Thread(...); ...; self._thread = t
+                            thread_attrs.setdefault(
+                                tq2.split(".", 1)[1], node.value
+                            )
+                            started.add(tq2.split(".", 1)[1])
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("join", "start")
+                ):
+                    rq = qualname(node.func.value)
+                    tgt_set = joined if node.func.attr == "join" else started
+                    if rq and rq.startswith("self."):
+                        tgt_set.add(rq.split(".", 1)[1])
+                    elif isinstance(node.func.value, ast.Name):
+                        a = attr_alias.get(node.func.value.id)
+                        if a is not None:
+                            tgt_set.add(a)
+        out = []
+        for attr, ctor in thread_attrs.items():
+            if attr in joined or attr not in started:
+                continue
+            out.append(
+                self.finding(
+                    ctx, ctor,
+                    "%s stores a Thread on self.%s but no method ever "
+                    "joins it — a leaked worker outlives close()/stop(); "
+                    "join the handle on every exit path (timeout is "
+                    "fine)" % (cls.name, attr),
+                )
+            )
+        return out
+
+    def _check_local(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        """Function-local threads (not stored on self / a container /
+        returned) must be joined in the same function."""
+        local: Dict[str, ast.AST] = {}
+        escaped: Set[str] = set()
+        joined: Set[str] = set()
+        started: Set[str] = set()
+        started_inline: List[ast.AST] = []
+        for node in walk_no_nested_funcs(fn):
+            if isinstance(node, ast.Assign) and self._is_thread_ctor(
+                node.value
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local[tgt.id] = node.value
+                    # self.X targets are the class check's business
+            elif isinstance(node, ast.Call):
+                # Thread(...).start() with no handle at all
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "start"
+                    and self._is_thread_ctor(node.func.value)
+                ):
+                    started_inline.append(node)
+                if isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name
+                ):
+                    if node.func.attr == "join":
+                        joined.add(node.func.value.id)
+                    elif node.func.attr == "start":
+                        started.add(node.func.value.id)
+                # passed elsewhere (registered with an owner): escapes
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if isinstance(arg, ast.Name):
+                        escaped.add(arg.id)
+            elif isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ):
+                escaped.add(node.value.id)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Name
+            ):
+                for tgt in node.targets:
+                    tq = qualname(tgt)
+                    if tq and "." in tq:  # self.X / obj.attr = t
+                        escaped.add(node.value.id)
+        out = []
+        for name, ctor in local.items():
+            if name not in started or name in joined or name in escaped:
+                continue
+            out.append(
+                self.finding(
+                    ctx, ctor,
+                    "local Thread %r in %r is started but never joined "
+                    "in this function and never handed to an owner — "
+                    "it leaks past every exit path" % (name, fn.name),
+                )
+            )
+        for node in started_inline:
+            out.append(
+                self.finding(
+                    ctx, node,
+                    "Thread(...).start() without keeping the handle in "
+                    "%r — nothing can ever join it (thread leak by "
+                    "construction)" % fn.name,
+                )
+            )
+        return out
+
+
 RULES = (
     JitImpurity(),
     PrngReuse(),
@@ -1465,6 +1802,9 @@ RULES = (
     UnlockedSharedMutation(),
     CompatBypass(),
     FlagConfigDrift(),
+    ThreadCollective(),
+    AtomicPublish(),
+    ThreadJoin(),
 )
 
 

@@ -154,4 +154,5 @@ def write_prometheus(path: str, snapshot: dict, prefix: str = "pct") -> None:
     tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(prometheus_text(snapshot, prefix))
+    # graftcheck: noqa[atomic-publish] -- scrape artifact rewritten every interval: a scraper must never see a half file (rename atomicity), but fsync durability buys nothing a crash would not immediately overwrite
     os.replace(tmp, path)

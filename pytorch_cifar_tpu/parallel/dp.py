@@ -110,8 +110,10 @@ def data_parallel_train_step(
     copy savings with the async input pipeline keeping ``prefetch``
     batches in flight (XLA:CPU ignores input donation with a warning).
     graftcheck's donation-misuse rule traces reads-after-donate through
-    this wrapper (STATIC_ANALYSIS.md) — keep its wrapper table in sync
-    when changing the donated positions.
+    this wrapper (STATIC_ANALYSIS.md); since the whole-project pass the
+    donated positions are DERIVED from this function's own
+    ``jax.jit(..., donate_argnums=...)`` expression — change them here
+    and the rule follows automatically, aliases and renames included.
     """
     from pytorch_cifar_tpu import tpu_compiler_options
 
@@ -167,8 +169,9 @@ def data_parallel_train_epoch(
     fresh permutation per epoch and only this one dispatch ever reads
     it, so its buffer is free for XLA to reuse the moment the gather
     consumes it. The dataset arrays (argnums 2, 3) are deliberately NOT
-    donated — they persist across every epoch. Mirrored in graftcheck's
-    donation-misuse wrapper table (STATIC_ANALYSIS.md).
+    donated — they persist across every epoch. graftcheck's
+    donation-misuse rule derives all of this from the ``donate_argnums``
+    expression below (STATIC_ANALYSIS.md) — no hand-synced table.
     """
     from pytorch_cifar_tpu import tpu_compiler_options
 

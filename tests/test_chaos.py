@@ -90,12 +90,16 @@ def test_nan_injection_under_skip_stays_close_to_reference(tmp_path):
 def test_bench_chaos_smoke_contract(tmp_path):
     """bench.py --chaos-smoke publishes recovery time in the one-line
     JSON contract (metric/value/unit/vs_baseline) and fails loudly when
-    the drill does not recover."""
+    the drill does not recover. The contract is asserted on the LIGHT
+    model (LeNet, small reference run): the previous ResNet18 smoke
+    blew chaos_run's 900 s child timeout on 1-core CPU containers, so
+    this test never completed (CHANGES.md PR 7 note)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--chaos-smoke"],
-        capture_output=True, text=True, timeout=1800, cwd=REPO, env=env,
+        [sys.executable, os.path.join(REPO, "bench.py"), "--chaos-smoke",
+         "--model", "LeNet"],
+        capture_output=True, text=True, timeout=1500, cwd=REPO, env=env,
     )
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-3000:])
     lines = [

@@ -54,11 +54,14 @@ def run_rule(tmp_path, src: str, rule: str, name="snippet.py"):
 # ---------------------------------------------------------------------
 
 
-def test_rule_registry_has_at_least_eight_rules():
-    assert len(RULES) >= 8
+def test_rule_registry_has_at_least_eleven_rules():
+    assert len(RULES) >= 11
     assert len(set(rule_names())) == len(RULES)
     for r in RULES:
         assert r.summary, r.name
+    # the PR 8 additions are registered
+    for name in ("thread-collective", "atomic-publish", "thread-join"):
+        assert name in rule_names()
 
 
 def test_suppression_requires_reason(tmp_path):
@@ -486,6 +489,341 @@ def test_donation_misuse_traces_dp_wrappers_negative(tmp_path):
         return state, totals, images.shape, labels.shape
     """
     assert run_rule(tmp_path, src, "donation-misuse") == []
+
+
+def test_donation_misuse_aliased_wrapper_positive(tmp_path):
+    """THE aliased-wrapper escape from the old known-limits section:
+    `f = data_parallel_train_step; step = f(...)` used to slip past the
+    name-keyed table. The import-graph pass resolves the alias chain to
+    dp.py's def and derives the donated positions from its own
+    donate_argnums expression."""
+    src = """
+    from pytorch_cifar_tpu.parallel import data_parallel_train_step
+
+    f = data_parallel_train_step  # module-level alias
+
+    def run(fn, mesh, state, xd, yd, rng):
+        step = f(fn, mesh)
+        state2, m = step(state, (xd, yd), rng)
+        return state2, xd.sum()  # xd's buffer was donated via the alias
+    """
+    found = run_rule(tmp_path, src, "donation-misuse")
+    assert len(found) == 1 and "'xd'" in found[0].message
+
+    # function-local alias: the other spelling of the same escape
+    src2 = """
+    from pytorch_cifar_tpu.parallel import data_parallel_train_step
+
+    def run(fn, mesh, state, xd, yd, rng):
+        g = data_parallel_train_step
+        step = g(fn, mesh)
+        state2, m = step(state, (xd, yd), rng)
+        return state2, xd.sum()
+    """
+    found2 = run_rule(tmp_path, src2, "donation-misuse", "b.py")
+    assert len(found2) == 1 and "'xd'" in found2[0].message
+
+
+def test_donation_misuse_aliased_wrapper_negative(tmp_path):
+    # donate=False through an alias must still turn donation off — the
+    # gate parameter is read from dp.py's AST, not assumed
+    src = """
+    from pytorch_cifar_tpu.parallel import data_parallel_train_step
+
+    f = data_parallel_train_step
+
+    def run(fn, mesh, state, xd, yd, rng):
+        step = f(fn, mesh, donate=False)
+        state2, m = step(state, (xd, yd), rng)
+        return state2, xd.sum()
+    """
+    assert run_rule(tmp_path, src, "donation-misuse") == []
+
+
+def test_donation_misuse_cross_module_wrapper_fixture(tmp_path):
+    """Mini-package: a PROJECT-LOCAL wrapper module (not dp.py) whose
+    donate_argnums is derived from its own AST through the import graph
+    — renaming on import included."""
+    d = tmp_path / "minipkg"
+    d.mkdir()
+    (d / "wrap.py").write_text(textwrap.dedent("""
+    import jax
+
+    def make_step(fn, donate=True):
+        return jax.jit(fn, donate_argnums=(0,) if donate else ())
+    """))
+    (d / "use.py").write_text(textwrap.dedent("""
+    from wrap import make_step as build
+
+    def run(fn, state, batch):
+        step = build(fn)
+        out = step(state, batch)
+        return out, state.params  # state donated through the wrapper
+
+    def safe(fn, state, batch):
+        step = build(fn, donate=False)
+        out = step(state, batch)
+        return out, state.params
+    """))
+    run = lint_paths([str(d)], rules=rules_by_name(["donation-misuse"]))
+    found = [f for f in run.findings if f.rule == "donation-misuse"]
+    assert len(found) == 1
+    assert "'state'" in found[0].message
+    assert found[0].path.endswith("use.py")
+
+
+def test_jit_impurity_cross_module_traced_closure(tmp_path):
+    """A factory WITHOUT the make_*_step naming convention, jitted from
+    another module: the returned closure's side effect is flagged in the
+    factory's module (the old single-module blind spot)."""
+    d = tmp_path / "xmod"
+    d.mkdir()
+    (d / "factory.py").write_text(textwrap.dedent("""
+    def build_update(cfg):
+        def go(x):
+            print("traced side effect")
+            return x + cfg.scale
+        return go
+    """))
+    (d / "driver.py").write_text(textwrap.dedent("""
+    import jax
+    from factory import build_update
+
+    def main(cfg, xs):
+        upd = build_update(cfg)
+        fast = jax.jit(upd)
+        return fast(xs)
+    """))
+    run = lint_paths([str(d)], rules=rules_by_name(["jit-impurity"]))
+    found = [f for f in run.findings if f.rule == "jit-impurity"]
+    assert len(found) == 1
+    assert "print" in found[0].message
+    assert found[0].path.endswith("factory.py")
+
+
+def test_thread_collective_positive(tmp_path):
+    """Acceptance fixture: a broadcast_pytree inside a Thread(target=...)
+    worker — the AsyncCheckpointWriter multihost bug shape — including
+    when the collective hides in a helper in ANOTHER module."""
+    src = """
+    import threading
+    from pytorch_cifar_tpu.parallel.mesh import broadcast_pytree
+
+    class Publisher:
+        def _run(self):
+            while True:
+                broadcast_pytree(self.state)
+
+        def start(self):
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def stop(self):
+            self._thread.join()
+    """
+    found = run_rule(tmp_path, src, "thread-collective")
+    assert len(found) == 1
+    assert "broadcast_pytree" in found[0].message
+
+    # cross-module: Thread entry in worker.py, collective in util.py
+    d = tmp_path / "tc"
+    d.mkdir()
+    (d / "util.py").write_text(textwrap.dedent("""
+    from pytorch_cifar_tpu.parallel.mesh import broadcast_pytree
+
+    def sync_all(tree):
+        return broadcast_pytree(tree)
+    """))
+    (d / "worker.py").write_text(textwrap.dedent("""
+    import threading
+    from util import sync_all
+
+    def serve_forever(state):
+        def loop():
+            while True:
+                sync_all(state)
+        t = threading.Thread(target=loop)
+        t.start()
+        t.join()
+    """))
+    run = lint_paths([str(d)], rules=rules_by_name(["thread-collective"]))
+    found = [f for f in run.findings if f.rule == "thread-collective"]
+    assert len(found) == 1
+    assert found[0].path.endswith("util.py")
+    assert "sync_all" not in found[0].message.split("reachable")[0]
+
+
+def test_thread_collective_negative(tmp_path):
+    # a shim-routed collective on the MAIN thread (restore_checkpoint's
+    # shape) and a thread whose worker only touches local state: quiet
+    src = """
+    import threading
+    from pytorch_cifar_tpu.parallel.mesh import broadcast_pytree
+
+    def restore(tree):
+        # main-thread collective: every process reaches it in step
+        return broadcast_pytree(tree)
+
+    class Writer:
+        def _run(self):
+            while True:
+                self._commit()
+
+        def _commit(self):
+            pass  # filesystem barrier, no collectives
+
+        def start(self):
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def close(self):
+            self._thread.join()
+    """
+    assert run_rule(tmp_path, src, "thread-collective") == []
+
+
+def test_thread_join_positive(tmp_path):
+    src = """
+    import threading
+
+    class Leaky:
+        def start(self):
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def _run(self):
+            pass
+
+    def fire_and_forget(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        return None
+    """
+    found = run_rule(tmp_path, src, "thread-join")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 2
+    assert "self._thread" in msgs and "'t'" in msgs
+
+
+def test_thread_join_negative(tmp_path):
+    # the repo's real shapes: join via a local alias taken under a lock
+    # (watcher/exporter), direct join (batcher), and a function-local
+    # worker joined in its finally block (the Dataloader prefetcher)
+    src = """
+    import threading
+
+    class Watcher:
+        def start(self):
+            self._thread = threading.Thread(target=self._run)
+            self._thread.start()
+
+        def stop(self):
+            with self._lock:
+                t = self._thread
+                self._thread = None
+            if t is not None:
+                t.join()
+
+        def _run(self):
+            pass
+
+    def prefetch(items):
+        worker = threading.Thread(target=list)
+        worker.start()
+        try:
+            yield from items
+        finally:
+            worker.join(timeout=30.0)
+
+    def handoff(owner):
+        t = threading.Thread(target=list)
+        t.start()
+        owner.register(t)  # ownership transferred, owner joins
+    """
+    assert run_rule(tmp_path, src, "thread-join") == []
+
+
+def test_atomic_publish_positive(tmp_path):
+    src = """
+    import json
+    import os
+
+    def publish(path, data):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)  # rename journaled before the data blocks
+
+    def backwards_commit(output_dir, name, payload, meta):
+        _atomic_write(meta_path(output_dir, name), meta)  # marker FIRST
+        _atomic_write(os.path.join(output_dir, name), payload)
+    """
+    found = run_rule(tmp_path, src, "atomic-publish")
+    assert len(found) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "no fsync" in msgs
+    assert "commit marker" in msgs and "LAST" in msgs
+
+
+def test_atomic_publish_negative(tmp_path):
+    # the sanctioned _atomic_write shape, and payload-then-marker order
+    src = """
+    import json
+    import os
+
+    def atomic_write(path, data):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def commit(output_dir, name, payload, meta):
+        path = os.path.join(output_dir, name)
+        _atomic_write(path, payload)
+        _atomic_write(meta_path(output_dir, name), meta)  # marker LAST
+
+    def reader(path):
+        with open(path) as f:  # reads never flagged
+            return f.read()
+    """
+    assert run_rule(tmp_path, src, "atomic-publish") == []
+
+
+def test_host_sync_reaches_helpers(tmp_path):
+    """The reachability upgrade: a sync hidden in a HELPER the old
+    per-function table never named is now hot (called from train_epoch),
+    while the same code in an unreachable function stays quiet."""
+    d = tmp_path / "train"
+    d.mkdir()
+    src = """
+    import jax
+
+    class Trainer:
+        def train_epoch(self, epoch):
+            for batch in self.loader:
+                state, metrics = self.train_step(state, batch, rng)
+                self._accumulate(metrics)
+            return state
+
+        def _accumulate(self, metrics):
+            # helper on the hot path: per-step sync
+            self.total += metrics["loss_sum"].item()
+
+        def offline_report(self, metrics):
+            # NOT reachable from any seed: same code, never flagged
+            return metrics["loss_sum"].item()
+    """
+    p = d / "trainer.py"
+    p.write_text(textwrap.dedent(src))
+    found = [
+        f
+        for f in lint_file(str(p), rules=rules_by_name(["host-sync"]))
+        if f.rule == "host-sync"
+    ]
+    assert len(found) == 1
+    assert "_accumulate" in found[0].message
 
 
 def test_unlocked_shared_mutation_positive(tmp_path):

@@ -445,6 +445,109 @@ def test_lint_cli_changed_mode(tmp_path):
     assert "committed.py" not in r.stdout
 
 
+def test_lint_cli_graph_and_stats(tmp_path):
+    """--graph dumps the resolved import graph as JSON and --stats
+    reports per-rule timing + file counts — the contract future rule
+    authors use to see what the whole-project pass resolved."""
+    d = tmp_path / "mini"
+    d.mkdir()
+    (d / "base.py").write_text("def helper():\n    return 1\n")
+    (d / "app.py").write_text(
+        "from base import helper\n\ndef main():\n    return helper()\n"
+    )
+    lint = os.path.join(REPO, "tools", "lint.py")
+    r = _run_tool([lint, "--graph", str(d)])
+    g = json.loads(r.stdout)
+    assert g["version"] == 1
+    mods = g["modules"]
+    assert "base" in mods and "app" in mods
+    assert mods["app"]["imports"] == ["base"]
+    assert mods["app"]["path"].endswith("app.py")
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    r = _run_tool(
+        [lint, "--no-baseline", "--json", "--stats", str(dirty)],
+        expected_returncode=1,
+    )
+    rep = json.loads(r.stdout)
+    assert rep["stats"]["files"] == 1
+    pr = rep["stats"]["rules"]["prng-reuse"]
+    assert pr["findings"] == 1 and pr["seconds"] >= 0
+    # every registered rule reports a timing entry
+    assert set(rep["stats"]["rules"]) == set(rep["rules"])
+    # text mode appends one parseable stats line
+    r = _run_tool(
+        [lint, "--no-baseline", "--stats", str(dirty)],
+        expected_returncode=1,
+    )
+    (stats_line,) = [
+        ln for ln in r.stdout.splitlines()
+        if ln.startswith("graftcheck stats: ")
+    ]
+    json.loads(stats_line.split(": ", 1)[1])
+
+
+def test_lint_cli_changed_relints_reverse_dependencies(tmp_path):
+    """--changed + the import graph: a change to a library module
+    re-lints its COMMITTED callers (a dp.py donation change must
+    re-check every caller) — the pre-commit gate drill."""
+    import shutil
+    import subprocess as sp
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = dict(os.environ)
+    env.update(
+        GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+        GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+    )
+
+    def git(*args):
+        sp.run(["git", *args], cwd=repo, check=True, env=env,
+               capture_output=True)
+
+    git("init", "-q")
+    tools = repo / "tools"
+    tools.mkdir()
+    shutil.copy(os.path.join(REPO, "tools", "lint.py"), tools / "lint.py")
+    pkg = repo / "pytorch_cifar_tpu"
+    shutil.copytree(
+        os.path.join(REPO, "pytorch_cifar_tpu", "lint"), pkg / "lint"
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text("")
+    # a library module, and a COMMITTED caller with a latent finding
+    (pkg / "lib.py").write_text("def helper(key):\n    return key\n")
+    (tools / "app.py").write_text(
+        "import jax\n"
+        "from pytorch_cifar_tpu.lib import helper\n\n"
+        "def f(key):\n"
+        "    a = jax.random.bernoulli(helper(key))\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # change ONLY the library: --changed must re-lint the caller too
+    (pkg / "lib.py").write_text(
+        "def helper(key):\n    return key  # touched\n"
+    )
+    r = sp.run(
+        [sys.executable, str(tools / "lint.py"), "--changed",
+         "--no-baseline"],
+        capture_output=True, text=True, cwd=repo, env=env, timeout=120,
+    )
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "reverse dependenc" in r.stdout
+    assert "app.py" in r.stdout and "[prng-reuse]" in r.stdout
+
+
 def test_precommit_hook_blocks_seeded_finding(tmp_path):
     """tools/githooks/pre-commit (the `git config core.hooksPath
     tools/githooks` install) runs `tools/lint.py --changed` and must exit

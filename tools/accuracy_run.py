@@ -329,6 +329,8 @@ def _write_summary(
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(summary, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return summary
 

@@ -210,6 +210,8 @@ def _publish_checkpoint(src_dir: str, dst_dir: str) -> None:
         dst = os.path.join(dst_dir, name)
         tmp = dst + f".tmp.{os.getpid()}"
         shutil.copyfile(src, tmp)
+        with open(tmp, "rb") as f:
+            os.fsync(f.fileno())
         os.replace(tmp, dst)
 
 

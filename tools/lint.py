@@ -3,11 +3,14 @@
 
     python tools/lint.py                        # the default tree
     python tools/lint.py pytorch_cifar_tpu/serve
-    python tools/lint.py --changed              # only `git diff` files
+    python tools/lint.py --changed              # `git diff` files + their
+                                                # reverse dependencies
     python tools/lint.py --json                 # machine-readable
     python tools/lint.py --list-rules
     python tools/lint.py --rules prng-reuse,jit-impurity somefile.py
     python tools/lint.py --write-baseline       # grandfather what's open
+    python tools/lint.py --graph                # dump the import graph
+    python tools/lint.py --stats                # per-rule timing report
 
 Exit codes: 0 clean (suppressed/baselined findings do not fail the run),
 1 unsuppressed findings (including malformed noqa comments and files
@@ -61,6 +64,10 @@ EXIT_FINDINGS = 1
 EXIT_USAGE = 2
 
 
+def default_paths() -> list:
+    return [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+
+
 def changed_files() -> list:
     """Modified + untracked .py files from git — the pre-commit inner
     loop (lint only what this change touches)."""
@@ -81,6 +88,38 @@ def changed_files() -> list:
         if p.endswith(".py") and os.path.isfile(os.path.join(REPO, p)):
             paths.append(os.path.join(REPO, p))
     return paths
+
+
+def with_reverse_dependencies(changed: list) -> list:
+    """``--changed`` + the import graph: also re-lint every module in
+    the default tree whose import closure reaches a changed file. A
+    dp.py donation change must re-check its CALLERS — the wrapper table
+    is derived from dp.py's AST, so the files that read stale donated
+    buffers are the callers, not dp.py itself. Keeps the pre-commit
+    hook sound without linting the whole tree."""
+    from pytorch_cifar_tpu.lint.engine import (
+        _Project,
+        collect_python_files,
+    )
+
+    try:
+        files = collect_python_files(
+            [p for p in default_paths() if os.path.exists(p)]
+        )
+    except FileNotFoundError:
+        return changed
+    all_files = sorted(set(files) | {os.path.abspath(p) for p in changed})
+    graph = _Project(REPO, files=all_files).graph()
+    extra = [
+        p for p in graph.reverse_dependents(changed)
+        if os.path.isfile(p)
+    ]
+    if extra:
+        print(
+            "graftcheck: +%d reverse dependenc%s of changed files"
+            % (len(extra), "y" if len(extra) == 1 else "ies")
+        )
+    return sorted({os.path.abspath(p) for p in changed} | set(extra))
 
 
 def main(argv=None) -> int:
@@ -107,6 +146,12 @@ def main(argv=None) -> int:
                     "baseline file and exit 0")
     ap.add_argument("--verbose", action="store_true",
                     help="also print suppressed/baselined findings")
+    ap.add_argument("--graph", action="store_true",
+                    help="dump the resolved import graph as JSON "
+                    "(module -> imports) and exit")
+    ap.add_argument("--stats", action="store_true",
+                    help="report per-rule wall time + finding counts "
+                    "(text: appended line; --json: a 'stats' field)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
@@ -137,10 +182,28 @@ def main(argv=None) -> int:
         if not paths:
             print("graftcheck: no changed .py files")
             return EXIT_CLEAN
+        paths = with_reverse_dependencies(paths)
     elif args.paths:
         paths = args.paths
     else:
-        paths = [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+        paths = default_paths()
+
+    if args.graph:
+        import json
+
+        from pytorch_cifar_tpu.lint.engine import (
+            _Project,
+            collect_python_files,
+        )
+
+        try:
+            files = collect_python_files(paths)
+        except FileNotFoundError as e:
+            print("graftcheck: no such path: %s" % e, file=sys.stderr)
+            return EXIT_USAGE
+        graph = _Project(REPO, files=files).graph()
+        print(json.dumps(graph.to_json()))
+        return EXIT_CLEAN
 
     try:
         run = lint_paths(paths, rules=rules, repo_root=REPO)
@@ -166,13 +229,32 @@ def main(argv=None) -> int:
             return EXIT_USAGE
         stale = match_baseline(run.findings, entries, run.files)
 
+    stats = None
+    if args.stats:
+        stats = {
+            "files": len(run.files),
+            "rules": {
+                name: {
+                    "seconds": round(s["seconds"], 4),
+                    "findings": s["findings"],
+                }
+                for name, s in sorted(run.stats.items())
+            },
+        }
     if args.json:
         import json
 
-        print(json.dumps(_engine.json_report(run.findings, stale)))
+        rep = _engine.json_report(run.findings, stale)
+        if stats is not None:
+            rep["stats"] = stats
+        print(json.dumps(rep))
     else:
         print(_engine.render_report(run.findings, stale,
                                     verbose=args.verbose))
+        if stats is not None:
+            import json
+
+            print("graftcheck stats: %s" % json.dumps(stats))
     open_count = sum(1 for f in run.findings if f.status == "open")
     return EXIT_FINDINGS if open_count else EXIT_CLEAN
 
