@@ -597,6 +597,89 @@ def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
     return report
 
 
+def run_serve_http(model: str, batch: int, steps: int, compute_dtype) -> dict:
+    """The network-path A/B (SERVING.md "HTTP frontend & router"): the
+    SAME engine + micro-batcher serve the SAME closed-loop load twice —
+    once in-process (the ``--serve`` protocol) and once through the HTTP
+    frontend over loopback (JSON + base64 wire format, HTTP/1.1
+    keep-alive, one frontend handler thread per client). ``value`` is the
+    HTTP img/s; ``http_vs_inproc`` is the network-path tax, and the p50/
+    p95/p99 percentiles are the full-wire client-observed latencies."""
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import (
+        BatcherBackend,
+        InferenceEngine,
+        MicroBatcher,
+        ServingFrontend,
+    )
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+
+    mesh = make_mesh()
+    n_devices = int(mesh.devices.size)
+    if n_devices == 1:
+        mesh = None  # exact single-chip engine path
+    max_b = min(128, batch)
+    buckets = tuple(sorted({b for b in (1, 8, 32, max_b) if b <= max_b}))
+    registry = MetricsRegistry()
+    engine = InferenceEngine.from_random(
+        model,
+        buckets=buckets,
+        compute_dtype=compute_dtype,
+        mesh=mesh,
+        registry=registry,
+    )
+    batcher = MicroBatcher(
+        engine,
+        max_batch=max_b,
+        max_wait_ms=2.0,
+        max_queue=8 * max_b,
+        registry=registry,
+    )
+    frontend = ServingFrontend(
+        BatcherBackend(engine, batcher), registry=registry
+    ).start()
+    requests = max(steps, 2)
+    try:
+        run_load(  # warmup: page executables + open keep-alive conns
+            HttpTarget(frontend.url), clients=2, requests_per_client=2,
+            seed=1,
+        )
+        inproc = run_load(
+            batcher, clients=8, requests_per_client=requests,
+            images_max=8, seed=0,
+        )
+        report = run_load(
+            HttpTarget(frontend.url), clients=8,
+            requests_per_client=requests, images_max=8, seed=0,
+        )
+    finally:
+        frontend.stop()
+        batcher.close()
+    assert engine.compile_count == len(engine.buckets), (
+        "serving bench recompiled after warmup"
+    )
+    report["max_batch"] = max_b
+    report["n_devices"] = n_devices
+    report["inproc_img_per_sec"] = round(inproc["img_per_sec"], 3)
+    report["http_vs_inproc"] = round(
+        report["img_per_sec"] / max(inproc["img_per_sec"], 1e-9), 4
+    )
+    s = registry.summary()
+    report["obs"] = {
+        "http_requests": s.get("serve.http_requests", 0.0),
+        "http_errors": s.get("serve.http_errors", 0.0),
+        "http_p95_ms": round(s.get("serve.http_ms.p95", 0.0), 3),
+        # server-side handler time vs the client-observed percentiles
+        # above = the wire + queueing gap
+        "latency_p95_ms": round(s.get("serve.latency_ms.p95", 0.0), 3),
+        "batch_occupancy_mean": round(
+            s.get("serve.batch_occupancy.mean", 0.0), 4
+        ),
+    }
+    return report
+
+
 def prior_round_value(metric: str):
     """OLDEST recorded BENCH_r{N}.json value for this exact metric.
 
@@ -858,6 +941,13 @@ def main() -> int:
         "closed-loop synthetic clients, p50/p95/p99 latency in the record",
     )
     parser.add_argument(
+        "--serve-http", action="store_true", dest="serve_http",
+        help="measure serving through the HTTP frontend over loopback "
+        "(serve/frontend.py, SERVING.md): same engine+batcher+closed "
+        "loop as --serve, A/B'd in-process vs the full network path — "
+        "p50/p95/p99 + img/s + http_vs_inproc in the single-line record",
+    )
+    parser.add_argument(
         "--ckpt", action="store_true",
         help="measure the checkpoint layer: async-vs-sync save stall "
         "(trainer-thread blocked time, bit-identical files required) and "
@@ -888,6 +978,7 @@ def main() -> int:
         or args.epoch
         or args.step
         or args.serve
+        or args.serve_http
         or args.ckpt
         or args.config is not None
     ):
@@ -939,6 +1030,30 @@ def main() -> int:
             obs=report["obs"],
         )
         name = f"serve_throughput_{args.model}_b{report['max_batch']}"
+    elif args.serve_http:
+        report = run_serve_http(
+            args.model, args.batch, args.steps, compute_dtype
+        )
+        value = report["img_per_sec"]
+        # TOTAL img/s through the full network path (loopback HTTP);
+        # the in-process number and the ratio ride along
+        unit = "images/sec"
+        extra = {
+            k: round(report[k], 3)
+            for k in ("p50_ms", "p95_ms", "p99_ms", "mean_ms")
+        }
+        extra.update(
+            requests=report["requests"],
+            rejected=report["rejected"],
+            hedged=report["hedged"],
+            failed=report["failed"],
+            clients=report["clients"],
+            n_devices=report["n_devices"],
+            inproc_img_per_sec=report["inproc_img_per_sec"],
+            http_vs_inproc=report["http_vs_inproc"],
+            obs=report["obs"],
+        )
+        name = f"serve_http_{args.model}_b{report['max_batch']}"
     elif args.config is not None:
         models, batch = CONFIGS[args.config]
         batch = min(batch, args.batch) if platform == "cpu" else batch

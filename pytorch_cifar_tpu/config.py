@@ -222,6 +222,11 @@ class ServeConfig:
     max_batch: int = 0  # 0 = the largest bucket
     max_wait_ms: float = 2.0
     max_queue: int = 1024
+    # priority lanes (SERVING.md "priority classes"): bulk-priority
+    # requests may occupy at most this share of max_queue and dispatch
+    # only when no interactive request is queued — a bulk flood can
+    # never starve interactive traffic past its deadline
+    bulk_share: float = 0.5
     # per-request deadline: a request still queued this many ms after
     # submit fails fast with DeadlineExceeded instead of occupying a
     # coalesced batch (an engine stall otherwise strands every queued
@@ -261,10 +266,21 @@ class ServeConfig:
     # compiling. "" = no cache.
     aot_cache: str = ""
 
+    # HTTP frontend (SERVING.md "HTTP frontend & router"): with
+    # http_port >= 0 the process serves POST /predict + GET /healthz +
+    # live Prometheus GET /metrics over http.server instead of running
+    # the in-process load generator, until SIGTERM/SIGINT (graceful
+    # drain) or duration_s elapses. 0 binds an ephemeral port (printed
+    # on stderr as "==> http: serving on URL" — the router launcher and
+    # tests parse it); -1 keeps the PR 1-7 in-process loadgen behavior.
+    http_port: int = -1
+    http_host: str = "127.0.0.1"
+
     # observability (OBSERVABILITY.md): host-span trace file, periodic
     # JSONL metrics (queue depth, batch occupancy, admission-to-completion
     # latency, expiries, reloads), and a Prometheus text dump written at
-    # exit (the scrape-file convention; there is no HTTP frontend yet)
+    # exit (the scrape-file convention — the HTTP frontend additionally
+    # serves the same text LIVE at GET /metrics)
     trace_out: str = ""
     metrics_out: str = ""
     metrics_every_s: float = 10.0

@@ -20,11 +20,21 @@ becomes a long-lived prediction service:
   bucket executables (``--aot_cache``), so a fresh replica cold-starts in
   load time with zero compiles — every import probe-verified
   (SERVING.md "AOT executable cache").
+- :mod:`~pytorch_cifar_tpu.serve.frontend` is the HTTP edge
+  (``/predict`` + ``/healthz`` + live Prometheus ``/metrics`` over
+  stdlib ``http.server``), and
+  :mod:`~pytorch_cifar_tpu.serve.router` spreads that traffic over N
+  replica processes (health probes + eviction, least-loaded dispatch,
+  hedge-to-second-replica, priority-aware admission) behind the SAME
+  frontend — ``serve.py --http_port`` runs one replica,
+  ``tools/router_run.py`` runs the fleet (SERVING.md "HTTP frontend &
+  router").
 
 See SERVING.md for the architecture and tuning knobs.
 """
 
 from pytorch_cifar_tpu.serve.batcher import (  # noqa: F401
+    PRIORITIES,
     BatcherClosed,
     DeadlineExceeded,
     MicroBatcher,
@@ -34,4 +44,9 @@ from pytorch_cifar_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
     load_checkpoint_trees,
 )
+from pytorch_cifar_tpu.serve.frontend import (  # noqa: F401
+    BatcherBackend,
+    ServingFrontend,
+)
 from pytorch_cifar_tpu.serve.reload import CheckpointWatcher  # noqa: F401
+from pytorch_cifar_tpu.serve.router import Router  # noqa: F401

@@ -21,6 +21,21 @@ piece that turns N independent clients into that shape:
   time instead of occupying a coalesced batch — when the engine stalls,
   callers get a bounded-latency error they can retry elsewhere, not a
   forever-pending future (ROBUSTNESS.md).
+- **Priority lanes** (SERVING.md "priority classes"): a request is either
+  ``"interactive"`` (the default: a user is waiting on it) or ``"bulk"``
+  (batch scoring, backfills — throughput matters, latency does not). Two
+  fairness guarantees keep a bulk flood from starving interactive
+  traffic, which plain FIFO demonstrably does NOT (the pre-lane batcher
+  served a deep bulk backlog to completion before touching an interactive
+  request queued behind it — past any reasonable deadline):
+  (1) *dispatch order*: batch formation drains the interactive lane
+  first, so an interactive request waits at most one in-flight engine
+  call plus the interactive queue ahead of it, never the bulk backlog;
+  (2) *admission*: bulk may occupy at most ``bulk_share`` of ``max_queue``
+  (further bulk submits get :class:`QueueFull` — back off and retry),
+  so interactive submits always find queue headroom. Interactive-lane
+  FIFO order is unchanged from the single-lane batcher, and an all-
+  interactive workload behaves bit-for-bit as before.
 - **Graceful drain**: ``close()`` rejects new submissions immediately,
   finishes everything already admitted (so accepted requests are never
   dropped), then stops the worker. ``close(drain=False)`` fails pending
@@ -55,15 +70,27 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline passed while it was still queued."""
 
 
-class _Pending:
-    __slots__ = ("x", "n", "future", "expires_at", "admitted_at")
+# request-priority classes (SERVING.md): order = dispatch order
+PRIORITIES = ("interactive", "bulk")
 
-    def __init__(self, x: np.ndarray, expires_at: Optional[float] = None):
+
+class _Pending:
+    __slots__ = (
+        "x", "n", "future", "expires_at", "admitted_at", "priority"
+    )
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        expires_at: Optional[float] = None,
+        priority: str = "interactive",
+    ):
         self.x = x
         self.n = x.shape[0]
         self.future: Future = Future()
         self.expires_at = expires_at  # time.monotonic() deadline, or None
         self.admitted_at = 0.0  # perf_counter at admission (latency obs)
+        self.priority = priority
 
 
 class MicroBatcher:
@@ -75,6 +102,7 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         max_queue: int = 1024,
         default_deadline_ms: float = 0.0,
+        bulk_share: float = 0.5,
         autostart: bool = True,
         registry: Optional[MetricsRegistry] = None,
     ):
@@ -98,8 +126,18 @@ class MicroBatcher:
             # a queue smaller than one batch could never fill a batch
             raise ValueError("max_queue must be >= max_batch")
         self.default_deadline_ms = float(default_deadline_ms)
-        self._q: deque = deque()
+        # priority lanes (module docstring): dispatch drains lanes in
+        # PRIORITIES order; bulk admission is capped at bulk_share of the
+        # queue so a bulk flood can never crowd interactive submits out
+        if not 0.0 < bulk_share <= 1.0:
+            raise ValueError("bulk_share must be in (0, 1]")
+        self.bulk_share = float(bulk_share)
+        self._bulk_max = max(
+            self.max_batch, int(self.max_queue * self.bulk_share)
+        )
+        self._lanes = {p: deque() for p in PRIORITIES}
         self._queued_images = 0
+        self._queued_bulk_images = 0
         self._cond = threading.Condition()
         self._closed = False
         self._drain = True
@@ -117,6 +155,13 @@ class MicroBatcher:
         self._c_rejected = self.obs.counter("serve.rejected")
         self._c_expired = self.obs.counter("serve.expired")
         self._g_queue = self.obs.gauge("serve.queue_depth")
+        # per-priority accounting (the starvation regression's obs trail):
+        # bulk totals ride their own counters/gauge so the exporter can
+        # tell a healthy bulk backlog from interactive queue pressure
+        self._c_bulk_requests = self.obs.counter("serve.bulk_requests")
+        self._c_bulk_rejected = self.obs.counter("serve.bulk_rejected")
+        self._c_bulk_expired = self.obs.counter("serve.bulk_expired")
+        self._g_bulk_queue = self.obs.gauge("serve.bulk_queue_depth")
         # images per coalesced batch (its max is the old largest_batch)
         # and fill fraction against max_batch — the knob max_wait_ms
         # exists to move
@@ -147,7 +192,14 @@ class MicroBatcher:
 
     @property
     def stats(self) -> dict:
-        """Back-compat view over the registry (the PR 1 ``stats`` keys)."""
+        """Back-compat view over the registry (the PR 1 ``stats`` keys),
+        plus the per-priority accounting: ``queued`` holds the LIVE
+        per-lane image counts and the ``bulk_*`` keys total the bulk
+        lane's traffic (interactive = the totals minus bulk)."""
+        with self._cond:
+            queued = {
+                p: sum(r.n for r in self._lanes[p]) for p in PRIORITIES
+            }
         return {
             "requests": int(self._c_requests.value),
             "images": int(self._c_images.value),
@@ -155,46 +207,77 @@ class MicroBatcher:
             "rejected": int(self._c_rejected.value),
             "expired": int(self._c_expired.value),
             "largest_batch": int(self._h_batch.snapshot()["max"]),
+            "queued": queued,
+            "bulk_requests": int(self._c_bulk_requests.value),
+            "bulk_rejected": int(self._c_bulk_rejected.value),
+            "bulk_expired": int(self._c_bulk_expired.value),
         }
 
     # -- client side ---------------------------------------------------
 
     def submit(
-        self, images: np.ndarray, deadline_ms: Optional[float] = None
+        self,
+        images: np.ndarray,
+        deadline_ms: Optional[float] = None,
+        priority: str = "interactive",
     ) -> Future:
         """Enqueue a request; the Future resolves to fp32 logits for
         exactly these rows. Raises QueueFull/BatcherClosed synchronously
         so the caller can apply backpressure without blocking.
         ``deadline_ms`` bounds queue time (falls back to the constructor's
-        ``default_deadline_ms``; 0/None = no deadline)."""
+        ``default_deadline_ms``; 0/None = no deadline). ``priority`` picks
+        the lane (module docstring): ``"bulk"`` requests are admitted only
+        into their ``bulk_share`` queue slice and dispatch after every
+        queued interactive request."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r} (expected one of "
+                f"{PRIORITIES})"
+            )
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         expires_at = (
             time.monotonic() + deadline_ms / 1e3 if deadline_ms else None
         )
-        req = _Pending(np.asarray(images), expires_at)
+        req = _Pending(np.asarray(images), expires_at, priority)
         if req.n < 1:
             raise ValueError("empty request")
+        bulk = priority == "bulk"
         with self._cond:
             if self._closed:
                 raise BatcherClosed("batcher is closed")
-            if self._queued_images + req.n > self.max_queue:
+            if bulk:
+                self._c_bulk_requests.inc()
+            if self._queued_images + req.n > self.max_queue or (
+                bulk and self._queued_bulk_images + req.n > self._bulk_max
+            ):
                 self._c_rejected.inc()
+                if bulk:
+                    self._c_bulk_rejected.inc()
                 raise QueueFull(
-                    f"queue at {self._queued_images}/{self.max_queue} "
-                    f"images; retry later"
+                    f"{priority} queue at {self._queued_images}"
+                    f"/{self.max_queue} images "
+                    f"(bulk {self._queued_bulk_images}/{self._bulk_max}); "
+                    f"retry later"
                 )
             req.admitted_at = time.perf_counter()
-            self._q.append(req)
+            self._lanes[priority].append(req)
             self._queued_images += req.n
+            if bulk:
+                self._queued_bulk_images += req.n
             self._c_requests.inc()
-            self._g_queue.set(self._queued_images)
+            self._set_queue_gauges_locked()
             self._cond.notify()
         return req.future
 
-    def predict(self, images: np.ndarray) -> np.ndarray:
+    def predict(
+        self,
+        images: np.ndarray,
+        deadline_ms: Optional[float] = None,
+        priority: str = "interactive",
+    ) -> np.ndarray:
         """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(images).result()
+        return self.submit(images, deadline_ms, priority).result()
 
     # -- worker side ---------------------------------------------------
 
@@ -211,65 +294,95 @@ class MicroBatcher:
                 )
                 self._thread.start()
 
+    def _set_queue_gauges_locked(self) -> None:
+        self._g_queue.set(self._queued_images)
+        self._g_bulk_queue.set(self._queued_bulk_images)
+
+    def _remove_accounting_locked(self, req: _Pending) -> None:
+        """Queue-size bookkeeping for one request leaving a lane (caller
+        holds the lock and has already popped it)."""
+        self._queued_images -= req.n
+        if req.priority == "bulk":
+            self._queued_bulk_images -= req.n
+
+    def _expire_locked(self, req: _Pending, now: float) -> None:
+        self._remove_accounting_locked(req)
+        self._c_expired.inc()
+        if req.priority == "bulk":
+            self._c_bulk_expired.inc()
+        req.future.set_exception(
+            DeadlineExceeded(
+                f"request expired after "
+                f"{(now - req.expires_at) * 1e3:.1f} ms past its "
+                f"deadline while queued"
+            )
+        )
+
+    def _qlen_locked(self) -> int:
+        return sum(len(q) for q in self._lanes.values())
+
+    def _head_lane_locked(self):
+        """The lane the next request dispatches from: lanes drain in
+        PRIORITIES order, so bulk only moves when no interactive request
+        is queued — the anti-starvation dispatch rule."""
+        for p in PRIORITIES:
+            if self._lanes[p]:
+                return self._lanes[p]
+        return None
+
     def _fail_expired_locked(self) -> None:
         """Fail every queued request whose deadline has passed (caller
         holds the lock). Runs at batch-formation time: an expired request
         must not occupy a coalesced batch, and after an engine stall the
         backlog fails fast instead of being served pointlessly late."""
-        if not any(r.expires_at is not None for r in self._q):
+        if not any(
+            r.expires_at is not None
+            for q in self._lanes.values()
+            for r in q
+        ):
             return
         now = time.monotonic()
-        kept: deque = deque()
-        for req in self._q:
-            if req.expires_at is not None and now >= req.expires_at:
-                self._queued_images -= req.n
-                self._c_expired.inc()
-                req.future.set_exception(
-                    DeadlineExceeded(
-                        f"request expired after "
-                        f"{(now - req.expires_at) * 1e3:.1f} ms past its "
-                        f"deadline while queued"
-                    )
-                )
-            else:
-                kept.append(req)
-        self._q = kept
-        self._g_queue.set(self._queued_images)
+        for p, q in self._lanes.items():
+            kept: deque = deque()
+            for req in q:
+                if req.expires_at is not None and now >= req.expires_at:
+                    self._expire_locked(req, now)
+                else:
+                    kept.append(req)
+            self._lanes[p] = kept
+        self._set_queue_gauges_locked()
 
     def _take_batch(self):
         """Block until work exists, then coalesce up to max_batch images,
         waiting at most max_wait_ms after the first request is picked up.
-        Returns [] only at shutdown with an empty queue."""
+        Lanes drain in priority order (interactive first). Returns []
+        only at shutdown with an empty queue."""
         with self._cond:
             self._fail_expired_locked()
-            while not self._q and not self._closed:
+            while not self._qlen_locked() and not self._closed:
                 self._cond.wait()
                 self._fail_expired_locked()
-            if not self._q:
+            lane = self._head_lane_locked()
+            if lane is None:
                 return []  # closed and fully drained
-            batch = [self._q.popleft()]
+            batch = [lane.popleft()]
             total = batch[0].n
             deadline = time.monotonic() + self.max_wait_ms / 1e3
             while total < self.max_batch:
-                if self._q:
-                    head = self._q[0]
+                lane = self._head_lane_locked()
+                if lane is not None:
+                    head = lane[0]
                     if (
                         head.expires_at is not None
                         and time.monotonic() >= head.expires_at
                     ):
                         # expired while coalescing: fail it, keep going
-                        self._q.popleft()
-                        self._queued_images -= head.n
-                        self._c_expired.inc()
-                        head.future.set_exception(
-                            DeadlineExceeded(
-                                "request deadline passed while queued"
-                            )
-                        )
+                        lane.popleft()
+                        self._expire_locked(head, time.monotonic())
                         continue
                     if total + head.n > self.max_batch:
                         break  # requests are never split across batches
-                    batch.append(self._q.popleft())
+                    batch.append(lane.popleft())
                     total += head.n
                 else:
                     if self._closed:
@@ -280,10 +393,11 @@ class MicroBatcher:
                         break
                     self._cond.wait(remaining)
                     self._fail_expired_locked()
-                    if not self._q:
+                    if not self._qlen_locked():
                         break  # timeout or spurious wake with no work
-            self._queued_images -= total
-            self._g_queue.set(self._queued_images)
+            for req in batch:
+                self._remove_accounting_locked(req)
+            self._set_queue_gauges_locked()
             self._c_batches.inc()
             self._c_images.inc(total)
             self._h_batch.observe(total)
@@ -326,11 +440,12 @@ class MicroBatcher:
     # -- lifecycle -----------------------------------------------------
 
     def _fail_queued_locked(self, exc: Exception) -> None:
-        while self._q:
-            req = self._q.popleft()
-            self._queued_images -= req.n
-            req.future.set_exception(exc)
-        self._g_queue.set(self._queued_images)
+        for q in self._lanes.values():
+            while q:
+                req = q.popleft()
+                self._remove_accounting_locked(req)
+                req.future.set_exception(exc)
+        self._set_queue_gauges_locked()
 
     def close(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop accepting requests; by default finish everything already

@@ -19,13 +19,31 @@ latency, like the QueueFull backoff.
 
 Shared by ``serve.py`` and ``bench.py --serve`` so the reported p50/p95/p99
 and img/s always mean the same protocol.
+
+**HTTP client mode**: ``run_load`` drives anything with the batcher's
+``submit`` surface — :class:`HttpTarget` wraps a frontend/router URL in
+exactly that surface (one persistent HTTP/1.1 connection per client
+thread; 429/504/503 map back to ``QueueFull``/``DeadlineExceeded``/
+``BatcherClosed``), so ``bench.py --serve-http`` and the router chaos
+drill report the SAME closed-loop stats and hedge counters through the
+full network path that the in-process numbers mean.
+
+**Mixed-priority load**: ``bulk_fraction`` tags that share of requests
+``priority="bulk"`` (per-client deterministic rng), exercising the
+batcher's lanes and the router's priority-aware admission under one
+closed loop.
 """
 
 from __future__ import annotations
 
+import base64
+import http.client
+import json
+import socket
 import threading
 import time
 from typing import Optional
+from urllib.parse import urlsplit
 
 import numpy as np
 
@@ -34,6 +52,136 @@ from pytorch_cifar_tpu.serve.batcher import (
     DeadlineExceeded,
     QueueFull,
 )
+
+
+class _Resolved:
+    """Future-compatible wrapper over an already-computed result: the
+    HTTP exchange is synchronous, so by the time ``submit`` returns the
+    answer exists — ``result()`` just hands it over. Keeps ``run_load``'s
+    ``submit(...).result()`` protocol identical for both transports."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+
+class HttpTarget:
+    """A frontend/router URL exposed through the batcher's ``submit``
+    surface (module docstring). Thread-safe: each loadgen client thread
+    gets its own persistent HTTP/1.1 connection (``threading.local``),
+    reconnecting transparently when the server idles one out.
+
+    Error mapping is the frontend contract in reverse: 429 raises
+    :class:`QueueFull` (the client backs off and retries), 504 raises
+    :class:`DeadlineExceeded` (the client hedges once), 503 and
+    connection failures raise :class:`BatcherClosed` (counted failed).
+    """
+
+    def __init__(
+        self,
+        url: str,
+        *,
+        deadline_ms: Optional[float] = None,
+        timeout_s: float = 60.0,
+    ):
+        parts = urlsplit(url if "//" in url else f"http://{url}")
+        if parts.scheme != "http" or not parts.hostname:
+            raise ValueError(f"target url must be http://host:port: {url!r}")
+        self.host = parts.hostname
+        self.tcp_port = int(parts.port or 80)
+        self.url = f"http://{self.host}:{self.tcp_port}"
+        self.deadline_ms = deadline_ms
+        self.timeout_s = float(timeout_s)
+        self._local = threading.local()
+        self.obs = None  # loadgen's optional registry hook (run_load)
+
+    def _conn(self, fresh: bool = False) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        # a conn whose sock is gone (closed after a failure, or a
+        # connect() that raised before the cache slot was replaced) must
+        # be rebuilt, not reused — reusing it crashes on .sock access
+        if conn is None or fresh or conn.sock is None:
+            if conn is not None:
+                conn.close()
+            self._local.conn = None  # a failing connect leaves no stale cache
+            conn = http.client.HTTPConnection(
+                self.host, self.tcp_port, timeout=self.timeout_s
+            )
+            # TCP_NODELAY both ways (see frontend._Handler): without it
+            # Nagle + delayed ACK adds a flat ~40 ms per exchange
+            conn.connect()
+            conn.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+            self._local.conn = conn
+        return conn
+
+    def submit(
+        self,
+        images: np.ndarray,
+        deadline_ms: Optional[float] = None,
+        priority: str = "interactive",
+    ) -> _Resolved:
+        """One synchronous ``POST /predict``; returns a resolved future
+        of the fp32 logits (b64-packed on the wire: bit-identical to the
+        server's array)."""
+        from pytorch_cifar_tpu.serve.frontend import decode_logits
+
+        x = np.ascontiguousarray(np.asarray(images, dtype=np.uint8))
+        req = {
+            "images": base64.b64encode(x.tobytes()).decode("ascii"),
+            "shape": [int(v) for v in x.shape],
+            "priority": priority,
+            "encoding": "b64",
+        }
+        if deadline_ms is None:
+            deadline_ms = self.deadline_ms
+        if deadline_ms:
+            req["deadline_ms"] = float(deadline_ms)
+        body = json.dumps(req).encode("utf-8")
+        for attempt in (0, 1):
+            try:
+                conn = self._conn(fresh=attempt > 0)
+                conn.request(
+                    "POST", "/predict", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                payload = resp.read()
+                status = resp.status
+            except (
+                http.client.HTTPException,
+                ConnectionError,
+                TimeoutError,
+                OSError,
+            ) as e:
+                if attempt == 0:
+                    continue  # stale keep-alive: reconnect once
+                raise BatcherClosed(
+                    f"{self.url}: {type(e).__name__}: {e}"
+                ) from None
+            break
+        if status == 200:
+            return _Resolved(decode_logits(json.loads(payload)))
+        try:
+            err = json.loads(payload).get("error", "")
+        except ValueError:
+            err = payload[:200].decode("utf-8", "replace")
+        if status == 429:
+            raise QueueFull(f"{self.url}: {err}")
+        if status == 504:
+            raise DeadlineExceeded(f"{self.url}: {err}")
+        raise BatcherClosed(f"{self.url}: http {status}: {err}")
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
 
 
 def percentile_ms(latencies_ms, pct: float) -> float:
@@ -57,6 +205,7 @@ def run_load(
     retry_backoff_s: float = 0.002,
     duration_s: Optional[float] = None,
     hedge: bool = True,
+    bulk_fraction: float = 0.0,
 ) -> dict:
     """Drive ``batcher`` with ``clients`` synchronous synthetic clients.
 
@@ -66,6 +215,12 @@ def run_load(
     ``duration_s`` wall seconds when given (whichever comes first).
     ``hedge``: resubmit a ``DeadlineExceeded`` request once before
     counting it failed (module docstring; ``--no-hedge`` disables).
+    ``bulk_fraction``: that share of requests carries
+    ``priority="bulk"`` (deterministic per-client rng; 0.0 keeps the
+    all-interactive protocol every earlier round reported).
+    ``batcher`` is anything with the submit surface — a
+    :class:`~pytorch_cifar_tpu.serve.batcher.MicroBatcher` or an
+    :class:`HttpTarget` (the full network path).
 
     Returns the latency/throughput report the CLIs publish:
     ``img_per_sec``, ``request_per_sec``, ``p50_ms``/``p95_ms``/``p99_ms``,
@@ -74,7 +229,9 @@ def run_load(
     """
     images_max = max(images_min, images_max)
     latencies_ms: list = []
-    counts = {"images": 0, "rejected": 0, "hedged": 0, "failed": 0}
+    counts = {
+        "images": 0, "rejected": 0, "hedged": 0, "failed": 0, "bulk": 0,
+    }
     lock = threading.Lock()
     stop_at = None
     # hedges ride the serving registry (when the batcher carries one) so
@@ -82,10 +239,10 @@ def run_load(
     obs = getattr(batcher, "obs", None)
     c_hedged = obs.counter("serve.hedged") if obs is not None else None
 
-    def submit_with_backoff(x):
+    def submit_with_backoff(x, priority):
         while True:
             try:
-                return batcher.submit(x)
+                return batcher.submit(x, priority=priority)
             except QueueFull:
                 # admission control said back off; the retry delay is
                 # part of the client-observed latency (t0 stays)
@@ -100,9 +257,17 @@ def run_load(
                 return
             n = int(rs.randint(images_min, images_max + 1))
             x = rs.randint(0, 256, size=(n, *image_shape)).astype(np.uint8)
+            priority = (
+                "bulk"
+                if bulk_fraction and rs.uniform() < bulk_fraction
+                else "interactive"
+            )
+            if priority == "bulk":
+                with lock:
+                    counts["bulk"] += 1
             t0 = time.perf_counter()
             try:
-                submit_with_backoff(x).result()
+                submit_with_backoff(x, priority).result()
             except DeadlineExceeded:
                 if not hedge:
                     with lock:
@@ -116,7 +281,7 @@ def run_load(
                 if c_hedged is not None:
                     c_hedged.inc()
                 try:
-                    submit_with_backoff(x).result()
+                    submit_with_backoff(x, priority).result()
                 except (DeadlineExceeded, BatcherClosed):
                     with lock:
                         counts["failed"] += 1
@@ -150,6 +315,7 @@ def run_load(
         "rejected": counts["rejected"],
         "hedged": counts["hedged"],
         "failed": counts["failed"],
+        "bulk_requests": counts["bulk"],
         "elapsed_s": round(elapsed, 4),
         "img_per_sec": counts["images"] / max(elapsed, 1e-9),
         "request_per_sec": len(latencies_ms) / max(elapsed, 1e-9),

@@ -16,13 +16,22 @@ The production-shaped entry point for the serving subsystem
   without dropping in-flight requests — point it at the output_dir of a
   RUNNING train.py and it tracks the best params as they improve.
 
-There is no HTTP frontend yet (ROADMAP open item); the built-in
-synthetic closed-loop load generator stands in for the network clients
-and doubles as the latency benchmark:
+Two traffic sources (SERVING.md "HTTP frontend & router"):
+
+- default: the built-in synthetic closed-loop load generator stands in
+  for network clients and doubles as the latency benchmark;
+- ``--http_port N``: the process becomes one REPLICA of the production
+  fleet — an HTTP frontend (``POST /predict`` with per-request
+  ``deadline_ms``/``priority``, ``GET /healthz``, live Prometheus
+  ``GET /metrics``) serves until SIGTERM/SIGINT or ``--duration_s``,
+  then drains gracefully. ``tools/router_run.py`` launches N of these
+  behind a router.
 
     python serve.py --ckpt ./checkpoint --model ResNet18
     python serve.py --ckpt ./checkpoint --model ResNet18 --watch \
         --clients 16 --requests 256 --max_wait_ms 5
+    python serve.py --ckpt ./checkpoint --model ResNet18 \
+        --http_port 8100 --deadline_ms 250 --aot_cache /tmp/aot
 
 Prints ONE JSON line on stdout with img/s and p50/p95/p99 latency
 (progress and engine info go to stderr); ``--verify`` additionally
@@ -36,6 +45,67 @@ import json
 import sys
 
 import numpy as np
+
+
+def _serve_http(cfg, engine, batcher, watcher, registry) -> dict:
+    """Run as one HTTP replica (SERVING.md "HTTP frontend & router"):
+    serve ``/predict`` + ``/healthz`` + live ``/metrics`` until
+    SIGTERM/SIGINT or ``--duration_s``, drain gracefully, and return a
+    loadgen-shaped report assembled from the obs registry so the
+    single-JSON-line contract keeps its keys in both modes."""
+    import signal
+    import threading
+    import time
+
+    from pytorch_cifar_tpu.obs.metrics import _percentile_from_buckets
+    from pytorch_cifar_tpu.serve import BatcherBackend, ServingFrontend
+
+    frontend = ServingFrontend(
+        BatcherBackend(engine, batcher, watcher=watcher),
+        host=cfg.http_host,
+        port=cfg.http_port,
+        registry=registry,
+    ).start()
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    # SIGTERM is the fleet's drain signal (router_run sends it); SIGINT
+    # keeps ^C working interactively. SIGKILL needs no handler — the
+    # chaos drill proves the ROUTER survives a replica dying hard.
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    print(f"==> http: serving on {frontend.url}", file=sys.stderr)
+    t0 = time.perf_counter()
+    stop.wait(cfg.duration_s or None)
+    print("==> http: draining", file=sys.stderr)
+    frontend.stop()  # no new requests; in-flight responses finish
+    elapsed = time.perf_counter() - t0
+
+    snap = registry.snapshot()
+    s = registry.summary()
+    http_ms = snap["histograms"].get("serve.http_ms")
+    requests = int(s.get("serve.http_ms.count", 0.0))
+    images = int(s.get("serve.http_images", 0.0))
+    return {
+        "clients": 0,  # open-loop: whatever the network brought
+        "requests": requests,
+        "images": images,
+        "rejected": int(s.get("serve.rejected", 0.0)),
+        "hedged": int(s.get("serve.hedged", 0.0)),
+        "failed": int(s.get("serve.http_errors", 0.0)),
+        "bulk_requests": int(s.get("serve.bulk_requests", 0.0)),
+        "elapsed_s": round(elapsed, 4),
+        "img_per_sec": images / max(elapsed, 1e-9),
+        "request_per_sec": requests / max(elapsed, 1e-9),
+        "mean_ms": s.get("serve.http_ms.mean", 0.0),
+        "p50_ms": s.get("serve.http_ms.p50", 0.0),
+        "p95_ms": s.get("serve.http_ms.p95", 0.0),
+        "p99_ms": (
+            _percentile_from_buckets(http_ms, 99.0) if http_ms else 0.0
+        ),
+    }
 
 
 def main() -> int:
@@ -147,6 +217,9 @@ def main() -> int:
         # fail-fast bound on queue time: an engine stall turns into
         # DeadlineExceeded for queued callers instead of unbounded waits
         default_deadline_ms=cfg.deadline_ms,
+        # priority lanes: bulk capped to this share of the queue and
+        # dispatched only behind interactive traffic (SERVING.md)
+        bulk_share=cfg.bulk_share,
         registry=registry,
     )
     exporter = None
@@ -166,15 +239,18 @@ def main() -> int:
         )
 
     try:
-        report = run_load(
-            batcher,
-            clients=cfg.clients,
-            requests_per_client=cfg.requests,
-            images_max=cfg.request_images_max,
-            seed=cfg.seed,
-            duration_s=cfg.duration_s or None,
-            hedge=cfg.hedge,
-        )
+        if cfg.http_port >= 0:
+            report = _serve_http(cfg, engine, batcher, watcher, registry)
+        else:
+            report = run_load(
+                batcher,
+                clients=cfg.clients,
+                requests_per_client=cfg.requests,
+                images_max=cfg.request_images_max,
+                seed=cfg.seed,
+                duration_s=cfg.duration_s or None,
+                hedge=cfg.hedge,
+            )
     finally:
         if watcher is not None:
             watcher.stop()

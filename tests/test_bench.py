@@ -257,3 +257,21 @@ def test_bench_ckpt_mode_prints_one_json_line():
     assert cs["cache_hits"] == 2
     assert cs["logits_match"] is True
     assert cs["no_cache_s"] > 0 and cs["warm_cache_s"] > 0
+
+
+def test_bench_serve_http_mode_prints_one_json_line():
+    """--serve-http (the HTTP frontend PR): the same driver contract
+    through the full network path — img/s `value` over loopback HTTP,
+    p50/p95/p99 + the in-process A/B ratio riding along, zero failed
+    requests on a healthy local stack."""
+    rec, out = run_bench(
+        ["--model", "LeNet", "--serve-http", "--steps", "2",
+         "--batch", "16"]
+    )
+    assert rec["unit"] == "images/sec"
+    assert rec["value"] > 0
+    assert rec["metric"].startswith("serve_http_LeNet_b16"), rec
+    assert rec["p99_ms"] >= rec["p95_ms"] >= rec["p50_ms"] > 0
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    assert rec["inproc_img_per_sec"] > 0 and rec["http_vs_inproc"] > 0
+    assert rec["obs"]["http_errors"] == 0

@@ -148,3 +148,23 @@ def test_ckpt_drill_kill_mid_async_save_and_torn_v3(tmp_path):
     assert rec["torn_v3_rejected"] is True  # fell back, never restored
     assert rec["inspect_rc_after"] == 0  # dir is clean again
     assert rec["recovery_s"] > 0
+
+
+def test_router_drill_sigkill_replica_under_load(tmp_path):
+    """--mode router (SERVING.md "HTTP frontend & router"): a 2-replica
+    fleet serves sustained mixed-priority HTTP load; replica 0 is
+    SIGKILLed mid-load. The router must evict it and keep serving —
+    bounded in-flight loss (hedged or failed-with-error, never hung),
+    post-evict p99 within the 2x steady-state SLO, zero router crashes —
+    the warm replica must have joined with compile_count == 0 (shared
+    AOT cache), and /predict must be bit-identical across both replicas
+    and the router."""
+    rec = run_chaos("router", tmp_path, extra=("--epochs", "2"))
+    assert rec["match"] is True
+    assert rec["warm_replica_compiles"] == 0
+    assert rec["bit_identical"] is True
+    assert rec["evictions"] >= 1
+    assert rec["healthy_after"] == 1
+    assert rec["p99_post_ms"] <= rec["p99_budget_ms"]
+    assert rec["failed_during_kill"] <= max(4, rec["requests"] // 20)
+    assert rec["router_rc"] == 0

@@ -1,0 +1,456 @@
+"""HTTP frontend + router tests (CPU, loopback-only — tier-1).
+
+The contracts pinned here are the ones SERVING.md "HTTP frontend &
+router" promises:
+
+- ``POST /predict`` returns logits BIT-identical to a direct in-process
+  ``engine.predict`` of the same rows, through BOTH wire encodings (JSON
+  float lists and b64-packed float32) and through the router;
+- ``GET /healthz`` tracks the engine's checkpoint generation across a
+  hot-reload weight swap;
+- ``GET /metrics`` is live Prometheus text that parses;
+- malformed requests map to 4xx with a reason, backend exceptions map to
+  the documented status codes (429/503/504);
+- ``stop()`` drains gracefully with NO leaked thread;
+- the router spreads load, hedges a dead replica's traffic to the
+  survivor, evicts after consecutive failures, reinstates on recovery,
+  and applies priority-aware admission (bulk 429s fail fast, interactive
+  ones retry a second replica).
+
+Real-engine cases share one module-scoped LeNet engine; protocol cases
+run against stub backends (no compile cost, deterministic failures).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorch_cifar_tpu.serve.batcher import (
+    BatcherClosed,
+    DeadlineExceeded,
+    QueueFull,
+)
+from pytorch_cifar_tpu.serve.frontend import (
+    BatcherBackend,
+    ServingFrontend,
+    decode_logits,
+)
+from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+from pytorch_cifar_tpu.serve.router import Router
+
+
+def _images(n, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url + "/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.load(resp)
+
+
+def _get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _b64_payload(x, **kw):
+    return {
+        "images": base64.b64encode(np.ascontiguousarray(x).tobytes())
+        .decode(),
+        "shape": list(x.shape),
+        **kw,
+    }
+
+
+class StubBackend:
+    """Protocol-test backend: constant logits, call counting, optional
+    scripted exception."""
+
+    def __init__(self, tag=1.0, raises=None):
+        self.tag = tag
+        self.raises = raises
+        self.engine_version = 1
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def predict(self, images, deadline_ms=None, priority="interactive"):
+        with self._lock:
+            self.calls += 1
+        if self.raises is not None:
+            raise self.raises
+        out = np.zeros((images.shape[0], 10), np.float32)
+        out[:, 0] = self.tag
+        return out
+
+    def health(self):
+        return {"status": "ok", "role": "stub", "tag": self.tag}
+
+
+@pytest.fixture(scope="module")
+def lenet_stack():
+    """One real engine + batcher + frontend for the bit-identity and
+    health cases (module-scoped: one LeNet compile for the whole file)."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve import InferenceEngine, MicroBatcher
+
+    # one registry through engine + batcher + frontend, the serve.py
+    # wiring: /metrics then scrapes the WHOLE serving process
+    registry = MetricsRegistry()
+    engine = InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4), compute_dtype=jnp.float32,
+        registry=registry,
+    )
+    batcher = MicroBatcher(
+        engine, max_batch=4, max_wait_ms=1, max_queue=64,
+        registry=registry,
+    )
+    frontend = ServingFrontend(
+        BatcherBackend(engine, batcher), registry=registry
+    ).start()
+    yield engine, batcher, frontend
+    frontend.stop()
+    batcher.close()
+
+
+# -- /predict ----------------------------------------------------------
+
+
+def test_predict_json_bit_identical_to_engine(lenet_stack):
+    """The tentpole contract: logits through the full HTTP path (JSON
+    request, JSON float-list response) equal an in-process
+    engine.predict of the same rows BIT-for-bit — float32 survives JSON
+    because repr(float64(float32)) round-trips exactly."""
+    engine, _, frontend = lenet_stack
+    x = _images(3, seed=1)
+    status, resp = _post(frontend.url, {"images": x.tolist()})
+    assert status == 200
+    got = decode_logits(resp)
+    want = engine.predict(x)
+    assert np.array_equal(got, want)
+    assert resp["labels"] == [int(v) for v in np.argmax(want, axis=-1)]
+    assert resp["n"] == 3
+
+
+def test_predict_b64_roundtrip_bit_identical(lenet_stack):
+    """Same contract through the packed encoding both ways (the wire
+    format the router and loadgen use: raw float32 bytes, no text
+    conversion anywhere)."""
+    engine, _, frontend = lenet_stack
+    x = _images(5, seed=2)  # off-bucket: exercises padding too
+    status, resp = _post(
+        frontend.url, _b64_payload(x, encoding="b64")
+    )
+    assert status == 200
+    assert resp["dtype"] == "float32" and resp["shape"] == [5, 10]
+    assert np.array_equal(decode_logits(resp), engine.predict(x))
+
+
+def test_predict_with_deadline_and_priority_fields(lenet_stack):
+    """The per-request knobs parse and serve: a generous deadline_ms and
+    an explicit bulk priority still answer correctly."""
+    engine, _, frontend = lenet_stack
+    x = _images(2, seed=3)
+    status, resp = _post(
+        frontend.url,
+        _b64_payload(x, deadline_ms=30000, priority="bulk"),
+    )
+    assert status == 200
+    assert np.array_equal(decode_logits(resp), engine.predict(x))
+
+
+# -- /healthz ----------------------------------------------------------
+
+
+def test_healthz_tracks_hot_reload_generation(lenet_stack):
+    """/healthz carries the engine weight generation: a hot-reload swap
+    (same trees re-swapped, the watcher's code path) bumps
+    engine_version in the next health answer."""
+    engine, _, frontend = lenet_stack
+    _, body = _get(frontend.url, "/healthz")
+    h0 = json.loads(body)
+    assert h0["status"] == "ok" and h0["model"] == "LeNet"
+    assert h0["engine_version"] == engine.version
+    assert h0["buckets"] == [1, 4]
+    import jax
+
+    params, stats = jax.device_get(engine._weights)
+    engine.swap_weights(params, stats)
+    _, body = _get(frontend.url, "/healthz")
+    assert json.loads(body)["engine_version"] == h0["engine_version"] + 1
+
+
+# -- /metrics ----------------------------------------------------------
+
+# one Prometheus text-format sample line: name{labels} value
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+$"
+)
+
+
+def test_metrics_is_live_parseable_prometheus_text(lenet_stack):
+    """GET /metrics renders the registry NOW in Prometheus text format:
+    every non-comment line parses, serving counters are present, and a
+    further request moves the counter (live, not a snapshot file)."""
+    _, _, frontend = lenet_stack
+    _post(frontend.url, {"images": _images(1).tolist()})
+    _, body = _get(frontend.url, "/metrics")
+    text = body.decode()
+    lines = [ln for ln in text.splitlines() if ln and not ln.startswith("#")]
+    assert lines, text
+    for ln in lines:
+        assert _PROM_LINE.match(ln), f"unparseable metrics line: {ln!r}"
+    assert "pct_serve_http_requests" in text
+    assert "pct_serve_requests" in text  # the batcher's counters ride too
+
+    def scrape_requests():
+        _, b = _get(frontend.url, "/metrics")
+        m = re.search(
+            r"^pct_serve_http_requests ([0-9.]+)$", b.decode(), re.M
+        )
+        return float(m.group(1))
+
+    before = scrape_requests()
+    _post(frontend.url, {"images": _images(1).tolist()})
+    assert scrape_requests() > before
+
+
+# -- error mapping -----------------------------------------------------
+
+
+def test_malformed_requests_get_4xx():
+    """Every malformed-input class maps to 400 with a reason; unknown
+    routes and methods map to 404/405. Stub backend: none of these may
+    ever reach predict."""
+    stub = StubBackend()
+    with ServingFrontend(stub) as fe:
+        cases = [
+            b"not json at all",
+            json.dumps([1, 2, 3]).encode(),  # not an object
+            json.dumps({}).encode(),  # no images
+            json.dumps({"images": "!!!notb64", "shape": [1, 32, 32, 3]})
+            .encode(),
+            json.dumps(
+                {"images": base64.b64encode(b"xx").decode(),
+                 "shape": [1, 32, 32, 3]}
+            ).encode(),  # byte count mismatch
+            json.dumps({"images": _images(1).tolist()[0]}).encode(),  # 3d
+            json.dumps(
+                {"images": _images(1).tolist(), "priority": "vip"}
+            ).encode(),
+            json.dumps(
+                {"images": _images(1).tolist(), "deadline_ms": -5}
+            ).encode(),
+            json.dumps(
+                {"images": _images(1).tolist(), "encoding": "msgpack"}
+            ).encode(),
+        ]
+        for body in cases:
+            req = urllib.request.Request(
+                fe.url + "/predict", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400, body
+            assert "error" in json.load(ei.value)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(fe.url + "/nope", timeout=10)
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(fe.url + "/predict", timeout=10)  # GET
+        assert ei.value.code == 405
+    assert stub.calls == 0
+
+
+def test_backend_exceptions_map_to_status_codes():
+    """The retry-policy contract: QueueFull -> 429, BatcherClosed -> 503,
+    DeadlineExceeded -> 504, arbitrary failure -> 500."""
+    for exc, code in (
+        (QueueFull("full"), 429),
+        (BatcherClosed("closed"), 503),
+        (DeadlineExceeded("late"), 504),
+        (RuntimeError("boom"), 500),
+    ):
+        with ServingFrontend(StubBackend(raises=exc)) as fe:
+            req = urllib.request.Request(
+                fe.url + "/predict",
+                data=json.dumps({"images": _images(1).tolist()}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == code, exc
+
+
+# -- lifecycle ---------------------------------------------------------
+
+
+def test_graceful_drain_no_thread_leak():
+    """stop() must leave NO frontend thread behind — accept loop, idle
+    keep-alive handlers (HttpTarget holds persistent connections), all
+    joined — and the port must stop answering."""
+    before = set(threading.enumerate())
+    stub = StubBackend()
+    fe = ServingFrontend(stub).start()
+    target = HttpTarget(fe.url)
+    # keep-alive handler threads exist and idle when this returns
+    run_load(target, clients=4, requests_per_client=4)
+    fe.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaked = set(threading.enumerate()) - before
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, [t.name for t in leaked]
+    with pytest.raises(BatcherClosed):
+        target.submit(_images(1))
+    target.close()
+
+
+def test_frontend_stop_is_idempotent():
+    fe = ServingFrontend(StubBackend()).start()
+    fe.stop()
+    fe.stop()  # second drain must be a no-op, not a crash
+
+
+# -- router ------------------------------------------------------------
+
+
+def test_router_spreads_load_and_reports_health():
+    """Least-loaded dispatch with round-robin tiebreak serves BOTH
+    replicas under sequential load, and the router health shows the
+    whole fleet."""
+    a, b = StubBackend(1.0), StubBackend(2.0)
+    with ServingFrontend(a) as fa, ServingFrontend(b) as fb:
+        with Router([fa.url, fb.url]) as r:
+            for _ in range(8):
+                out = r.predict(_images(1))
+                assert float(out[0, 0]) in (1.0, 2.0)
+            assert a.calls > 0 and b.calls > 0
+            assert r.probe_once() == 2
+            h = r.health()
+            assert h["status"] == "ok" and h["healthy_replicas"] == 2
+            assert [rep["health"]["tag"] for rep in h["replicas"]] == [
+                1.0, 2.0,
+            ]
+
+
+def test_router_hedges_to_survivor_and_evicts_dead_replica():
+    """Replica death mid-fleet: requests hedge to the survivor (no
+    caller-visible failure), the corpse is evicted after fail_after
+    consecutive failures, and a recovered replica is reinstated by the
+    probe."""
+    a, b = StubBackend(1.0), StubBackend(2.0)
+    fa = ServingFrontend(a).start()
+    port_a = fa.port
+    fb = ServingFrontend(b).start()
+    r = Router([fa.url, fb.url], fail_after=2)
+    fa.stop()  # SIGKILL stand-in: connection refused from now on
+    for _ in range(4):
+        out = r.predict(_images(1))
+        assert float(out[0, 0]) == 2.0  # every answer from the survivor
+    assert r.stats["hedged"] >= 1
+    assert r.stats["failed"] == 0
+    assert r.probe_once() == 1
+    assert r.stats["evictions"] == 1
+    h = r.health()
+    assert [rep["healthy"] for rep in h["replicas"]] == [False, True]
+    # recovery: a new frontend on the SAME port -> probe reinstates
+    fa2 = ServingFrontend(a, port=port_a).start()
+    assert r.probe_once() == 2
+    assert r.stats["reinstated"] == 1
+    r.stop()
+    fa2.stop()
+    fb.stop()
+
+
+def test_router_with_no_healthy_replica_raises_closed():
+    a = StubBackend()
+    fa = ServingFrontend(a).start()
+    r = Router([fa.url], fail_after=1)
+    fa.stop()
+    with pytest.raises(BatcherClosed):
+        r.predict(_images(1))
+    r.probe_once()
+    assert r.health()["status"] == "unavailable"
+    with pytest.raises(BatcherClosed):
+        r.predict(_images(1))  # evicted fleet: immediate unavailable
+    r.stop()
+
+
+def test_router_priority_aware_admission():
+    """A bulk 429 propagates to the bulk client immediately (no second
+    replica consulted); an interactive 429 retries the other replica and
+    succeeds — the fleet-level half of the batcher's lane policy."""
+    full, ok = StubBackend(raises=QueueFull("full")), StubBackend(2.0)
+    with ServingFrontend(full) as ff, ServingFrontend(ok) as fo:
+        with Router([ff.url, fo.url]) as r:
+            # drive until the full replica is the first pick, then pin
+            # the contract on that dispatch
+            saw_bulk_reject = False
+            for _ in range(6):
+                ok_before = ok.calls
+                try:
+                    r.predict(_images(1), priority="bulk")
+                except QueueFull:
+                    saw_bulk_reject = True
+                    # the rejection came from the full replica alone
+                    assert ok.calls == ok_before
+            assert saw_bulk_reject
+            for _ in range(6):
+                out = r.predict(_images(1), priority="interactive")
+                assert float(out[0, 0]) == 2.0  # spilled to the survivor
+            assert r.stats["rejected"] >= 1  # the bulk rejections
+
+
+def test_router_predict_bit_identical_through_real_engine(lenet_stack):
+    """One-replica fleet over the real engine: logits through frontend ->
+    router -> frontend -> batcher -> engine equal engine.predict
+    bit-for-bit (the chaos drill asserts the same across two replica
+    PROCESSES)."""
+    engine, _, frontend = lenet_stack
+    with Router([frontend.url]) as r:
+        x = _images(3, seed=9)
+        assert np.array_equal(r.predict(x), engine.predict(x))
+        # and through a frontend stacked on the router (the fleet edge)
+        with ServingFrontend(r) as edge:
+            status, resp = _post(
+                edge.url, _b64_payload(x, encoding="b64")
+            )
+            assert status == 200
+            assert np.array_equal(decode_logits(resp), engine.predict(x))
+
+
+def test_http_target_closed_loop_over_frontend(lenet_stack):
+    """run_load drives the wire exactly like the in-process batcher:
+    same report keys, zero failures, and the serve counters move."""
+    _, batcher, frontend = lenet_stack
+    before = batcher.stats["requests"]
+    target = HttpTarget(frontend.url)
+    rep = run_load(
+        target, clients=2, requests_per_client=4, images_max=3,
+        bulk_fraction=0.5, seed=3,
+    )
+    target.close()
+    assert rep["requests"] == 8 and rep["failed"] == 0
+    assert rep["images"] > 0 and rep["p99_ms"] >= rep["p50_ms"] > 0
+    assert 0 < rep["bulk_requests"] < 8  # the mix really was mixed
+    assert batcher.stats["requests"] >= before + 8

@@ -728,7 +728,7 @@ class _FlakyDeadlineBatcher:
         self.fail_every = fail_every
         self._Future = Future
 
-    def submit(self, images):
+    def submit(self, images, deadline_ms=None, priority="interactive"):
         from pytorch_cifar_tpu.serve import DeadlineExceeded
 
         self.calls += 1
@@ -1103,3 +1103,102 @@ def test_v3_checkpoint_loads_and_hot_reloads(tmp_path):
     assert eng.version == 1 and watcher.reloads == 1
     assert not np.array_equal(before, after)
     assert np.array_equal(after, eng.direct_forward(x))
+
+
+# -- priority lanes (SERVING.md "priority classes") ---------------------
+
+
+def test_interactive_meets_deadline_under_bulk_flood(lenet_engine):
+    """The starvation regression: a bulk flood saturates the queue, an
+    interactive request with a deadline arrives BEHIND it — the lane
+    dispatch order must serve the interactive request in the FIRST
+    formed batch, inside its deadline, while the flood drains later.
+    (The pre-lane FIFO batcher served the whole flood first; the
+    interactive future then expired at batch formation.)"""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=0, max_queue=256,
+        bulk_share=1.0, autostart=False,
+    )
+    flood = [
+        b.submit(_images(1, seed=i), priority="bulk") for i in range(64)
+    ]
+    fut = b.submit(_images(1, seed=99), deadline_ms=30000)
+    assert b.stats["queued"] == {"interactive": 1, "bulk": 64}
+    done_order = []
+    fut.add_done_callback(lambda f: done_order.append("interactive"))
+    for f in flood:
+        f.add_done_callback(lambda f: done_order.append("bulk"))
+    b.start()
+    out = fut.result(timeout=120)  # must NOT raise DeadlineExceeded
+    assert out.shape == (1, 10)
+    for f in flood:
+        f.result(timeout=120)  # the flood still completes (no drops)
+    b.close()
+    # the interactive request rode the FIRST dispatch wave: everything
+    # before it in completion order fits inside one coalesced batch
+    assert "interactive" in done_order
+    assert done_order.index("interactive") < b.max_batch, done_order
+    assert b.stats["bulk_requests"] == 64
+
+
+def test_bulk_admission_capped_interactive_headroom(lenet_engine):
+    """bulk_share caps the bulk lane: once bulk holds its slice, further
+    bulk submits get QueueFull while interactive submits still land —
+    the admission half of the anti-starvation policy."""
+    from pytorch_cifar_tpu.serve import MicroBatcher, QueueFull
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=0, max_queue=16,
+        bulk_share=0.5, autostart=False,
+    )
+    for i in range(8):  # exactly the bulk slice: 16 * 0.5
+        b.submit(_images(1, seed=i), priority="bulk")
+    with pytest.raises(QueueFull):
+        b.submit(_images(1), priority="bulk")
+    assert b.stats["bulk_rejected"] == 1
+    futs = [b.submit(_images(1, seed=i)) for i in range(8)]  # headroom
+    assert b.stats["queued"] == {"interactive": 8, "bulk": 8}
+    with pytest.raises(QueueFull):  # total cap still enforced
+        b.submit(_images(1))
+    b.start()
+    for f in futs:
+        f.result(timeout=120)
+    b.close()
+
+
+def test_priority_validation_and_stats_keys(lenet_engine):
+    """Unknown priorities are rejected synchronously; the per-priority
+    accounting keys ride batcher.stats."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(lenet_engine, max_batch=4, max_queue=16)
+    with pytest.raises(ValueError):
+        b.submit(_images(1), priority="vip")
+    out = b.predict(_images(2), priority="bulk")
+    assert out.shape == (2, 10)
+    s = b.stats
+    assert s["bulk_requests"] == 1 and s["bulk_rejected"] == 0
+    assert s["queued"] == {"interactive": 0, "bulk": 0}
+    b.close()
+
+
+def test_bulk_deadline_expiry_counted_per_lane(lenet_engine):
+    """An expired bulk request lands in both the total and the bulk
+    expiry counters (the exporter's per-lane view)."""
+    from pytorch_cifar_tpu.serve import DeadlineExceeded, MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=4, max_wait_ms=0, max_queue=16,
+        autostart=False,
+    )
+    fut = b.submit(_images(1), deadline_ms=0.001, priority="bulk")
+    import time as _time
+
+    _time.sleep(0.01)
+    b.start()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=60)
+    b.close()
+    assert b.stats["expired"] == 1 and b.stats["bulk_expired"] == 1

@@ -37,6 +37,16 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
              planted — tools/ckpt_inspect.py must flag it, the resume
              must fall back past it (no torn v3 ever restored), and the
              final state must still match the reference run.
+  router   — fleet drill (SERVING.md "HTTP frontend & router"): a
+             2-replica fleet behind tools/router_run.py serves sustained
+             mixed-priority HTTP load; one replica is SIGKILLed
+             mid-load. The router must hedge/evict and keep serving
+             (bounded in-flight loss: hedged or failed-with-error, never
+             hung; zero router crashes), post-evict p99 must hold within
+             2x the steady-state p99, the warm replica must have joined
+             the fleet with compile_count == 0 (shared AOT cache), and
+             /predict responses must be bit-identical across both
+             replicas and the router before the kill.
 
 Usage:
   python tools/chaos_run.py --mode sigterm
@@ -44,6 +54,7 @@ Usage:
   python tools/chaos_run.py --mode nan --epochs 3
   python tools/chaos_run.py --mode serve --serve-devices 8
   python tools/chaos_run.py --mode ckpt
+  python tools/chaos_run.py --mode router
 
 Subprocess-only: this driver never initializes a jax backend (the child
 runs own the device); comparisons read the msgpack checkpoints directly.
@@ -54,6 +65,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -380,6 +392,204 @@ def serve_drill(args, work: str) -> dict:
     }
 
 
+def router_drill(args, work: str) -> dict:
+    """The fleet drill (module docstring): SIGKILL one of two replicas
+    under sustained mixed-priority HTTP load; the router must evict,
+    reroute, and hold the latency/error SLO.
+
+    Phases:
+      0. fleet-up: router_run.py spawns 2 replicas (shared AOT cache —
+         replica 1 must join with compile_count == 0) + the router
+         frontend; this drill process then checks /predict bit-identity
+         across replica 0, replica 1, and the router.
+      1. steady state: closed-loop HTTP load -> p99_steady.
+      2. kill: same load with replica 0 SIGKILLed mid-phase -> loss must
+         be bounded (every request returns: served, hedged, or
+         failed-with-error) and the router must evict the corpse.
+      3. post-evict: same load on the surviving replica ->
+         p99_post <= 2x p99_steady (+ a small absolute floor: two
+         windows of a 1-core CPU box jitter more than a fleet).
+      4. drain: SIGTERM to router_run must exit 0 (zero router crashes)
+         with eviction counters in its JSON record.
+    """
+    import threading
+    import urllib.request
+
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+
+    ckpt_dir = os.path.join(work, "ckpt")
+    print(f"==> [router] training checkpoint -> {ckpt_dir}", file=sys.stderr)
+    run_to_completion(train_cmd(args, ckpt_dir), child_env(), args.timeout)
+
+    env = child_env()
+    env.pop("XLA_FLAGS", None)  # replicas: production 1-device shape
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "router_run.py"),
+        "--ckpt", ckpt_dir,
+        "--model", args.model,
+        "--replicas", "2",
+        "--buckets", "1", "4", "8",
+        "--aot_cache", os.path.join(work, "aot"),
+        "--deadline_ms", "2000",
+        "--probe_s", "0.2",
+        "--max_wait_ms", "1",
+    ]
+    print("==> [router] fleet up", file=sys.stderr)
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+
+    # parse the topology off router_run's stderr (forwarding as we read)
+    replica_re = re.compile(r"==> replica (\d+) pid=(\d+) url=(\S+)")
+    router_re = re.compile(r"==> router: serving on (\S+)")
+    replicas = {}
+    router_url = None
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"router_run exited rc={proc.returncode} before the "
+                    "router came up"
+                )
+            time.sleep(0.05)
+            continue
+        sys.stderr.write(line)
+        m = replica_re.search(line)
+        if m:
+            replicas[int(m.group(1))] = (int(m.group(2)), m.group(3))
+        m = router_re.search(line)
+        if m:
+            router_url = m.group(1)
+            break
+    if router_url is None or len(replicas) != 2:
+        proc.kill()
+        raise SystemExit("timed out waiting for the fleet topology")
+    # keep draining router_run's stderr so its pipe never fills
+    drain_t = threading.Thread(
+        target=lambda: [sys.stderr.write(ln) for ln in proc.stderr],
+        name="router-stderr-drain", daemon=True,
+    )
+    drain_t.start()
+
+    def healthz(url):
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            return json.load(r)
+
+    # acceptance: the warm replica joined the fleet with ZERO compiles
+    # (it imported replica 0's AOT cache exports — SERVING.md)
+    warm_compiles = int(healthz(replicas[1][1]).get("compiles", -1))
+
+    # bit-identity across the fleet: same payload to replica 0, replica
+    # 1, and the router must return byte-equal logits (the AOT-imported
+    # executables are probe-verified; this checks the whole wire too)
+    probe = np.random.RandomState(7).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    outs = [
+        HttpTarget(u).submit(probe).result()
+        for u in (replicas[0][1], replicas[1][1], router_url)
+    ]
+    bit_identical = all(np.array_equal(outs[0], o) for o in outs[1:])
+
+    def load_phase(tag, duration_s, seed):
+        rep = run_load(
+            HttpTarget(router_url),
+            clients=4,
+            requests_per_client=10**6,
+            images_max=4,
+            seed=seed,
+            duration_s=duration_s,
+            bulk_fraction=0.3,
+        )
+        print(
+            f"==> [router] {tag}: {rep['requests']} reqs "
+            f"p99={rep['p99_ms']:.1f}ms hedged={rep['hedged']} "
+            f"failed={rep['failed']}", file=sys.stderr,
+        )
+        return rep
+
+    print("==> [router] phase 1: steady state", file=sys.stderr)
+    steady = load_phase("steady", 5.0, seed=1)
+
+    print("==> [router] phase 2: SIGKILL replica 0 under load",
+          file=sys.stderr)
+    kill_at = threading.Timer(
+        2.0, os.kill, (replicas[0][0], signal.SIGKILL)
+    )
+    kill_at.start()
+    t_kill = time.monotonic()
+    killed = load_phase("kill", 6.0, seed=2)
+    kill_at.join()
+    kill_recovery_s = time.monotonic() - t_kill
+
+    print("==> [router] phase 3: post-evict steady state", file=sys.stderr)
+    post = load_phase("post-evict", 5.0, seed=3)
+
+    router_health = healthz(router_url)
+    healthy_after = int(router_health.get("healthy_replicas", -1))
+
+    print("==> [router] phase 4: drain", file=sys.stderr)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=args.timeout)
+    drain_t.join(timeout=10)
+    rec_run = None
+    for ln in out.splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                rec_run = json.loads(ln)
+            except ValueError:
+                continue
+    if rec_run is None:
+        raise SystemExit("router_run printed no JSON record")
+
+    # the SLO verdict (module docstring): post-evict p99 within 2x
+    # steady state (+25 ms floor for two-window CPU jitter), bounded
+    # loss during the kill window, zero router crashes
+    p99_budget_ms = max(2.0 * steady["p99_ms"], steady["p99_ms"] + 25.0)
+    loss_bound = killed["failed"] <= max(4, killed["requests"] // 20)
+    ok = (
+        proc.returncode == 0
+        and warm_compiles == 0
+        and bit_identical
+        and steady["requests"] > 0
+        and killed["requests"] > 0
+        and post["requests"] > 0
+        and steady["failed"] == 0
+        and loss_bound
+        and post["failed"] == 0
+        and post["p99_ms"] <= p99_budget_ms
+        and healthy_after == 1
+        and rec_run["router"]["evictions"] >= 1
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "router",
+        "match": ok,
+        "reference_s": round(steady["elapsed_s"], 2),
+        "recovery_s": round(kill_recovery_s, 2),
+        "warm_replica_compiles": warm_compiles,
+        "bit_identical": bit_identical,
+        "p99_steady_ms": round(steady["p99_ms"], 2),
+        "p99_kill_ms": round(killed["p99_ms"], 2),
+        "p99_post_ms": round(post["p99_ms"], 2),
+        "p99_budget_ms": round(p99_budget_ms, 2),
+        "requests": steady["requests"] + killed["requests"]
+        + post["requests"],
+        "failed_during_kill": killed["failed"],
+        "hedged_during_kill": killed["hedged"],
+        "healthy_after": healthy_after,
+        "evictions": rec_run["router"]["evictions"],
+        # router-SIDE hedges (transparent to the loadgen clients): the
+        # in-flight requests the kill would have lost without rerouting
+        "router_hedged": rec_run["router"]["hedged"],
+        "router_replica_errors": rec_run["router"]["replica_errors"],
+        "router_rc": proc.returncode,
+    }
+
+
 def _inspect(ckpt_dir: str) -> int:
     """tools/ckpt_inspect.py verdict for ``ckpt_dir`` (exit code)."""
     r = subprocess.run(
@@ -518,7 +728,10 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
         "--mode",
-        choices=("sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt"),
+        choices=(
+            "sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt",
+            "router",
+        ),
         default="sigterm",
     )
     p.add_argument(
@@ -563,12 +776,12 @@ def main() -> int:
 
     work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
 
-    if args.mode in ("serve", "ckpt"):
-        record = (
-            serve_drill(args, work)
-            if args.mode == "serve"
-            else ckpt_drill(args, work)
-        )
+    if args.mode in ("serve", "ckpt", "router"):
+        record = {
+            "serve": serve_drill,
+            "ckpt": ckpt_drill,
+            "router": router_drill,
+        }[args.mode](args, work)
         print(json.dumps(record))
         if record["match"] and not args.out:
             import shutil

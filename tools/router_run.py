@@ -1,0 +1,306 @@
+#!/usr/bin/env python3
+"""Fleet launcher: N replica serve.py processes behind one HTTP router.
+
+The production topology (SERVING.md "HTTP frontend & router") in one
+command:
+
+- spawns ``--replicas`` N ``serve.py --http_port 0`` processes (each its
+  own engine + mesh), replica 0 FIRST so it populates the shared
+  ``--aot_cache`` and every later replica cold-starts with
+  ``compile_count == 0`` (instant-scale-out: PR 7's executable cache was
+  built for exactly this),
+- waits for each replica's ``/healthz`` to go green,
+- starts a :class:`~pytorch_cifar_tpu.serve.router.Router` (health
+  probes, least-loaded dispatch, hedge-to-second-replica,
+  priority-aware admission) and binds the SAME HTTP frontend in front
+  of it — clients cannot tell the fleet from one replica,
+- then either drives the built-in closed-loop HTTP load generator
+  (``--clients > 0``) or serves until SIGTERM/SIGINT (the chaos drill's
+  mode: it SIGKILLs a replica out from under the router mid-load).
+
+Prints ONE JSON line on stdout (requests/latency percentiles + router
+hedge/eviction counters + per-replica compile counts); progress and the
+machine-parseable topology lines go to stderr:
+
+    ==> replica 0 pid=12345 url=http://127.0.0.1:41001
+    ==> router: serving on http://127.0.0.1:41000
+
+Usage:
+  python tools/router_run.py --ckpt ./checkpoint --model ResNet18 \
+      --replicas 2 --aot_cache /tmp/aot --clients 8 --requests 64
+  python tools/router_run.py --ckpt ./checkpoint --model LeNet \
+      --replicas 2 --deadline_ms 250        # serve until SIGTERM
+
+The router process itself never initializes a jax backend — replicas own
+the devices; this process moves bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+URL_RE = re.compile(r"==> http: serving on (http://\S+)")
+
+
+class ReplicaProc:
+    """One spawned serve.py replica: the process, a stderr-pump thread
+    (forwards lines with a ``[replica i]`` prefix and captures the
+    frontend URL), and the parsed URL."""
+
+    def __init__(self, idx: int, proc: subprocess.Popen):
+        self.idx = idx
+        self.proc = proc
+        # url is written by the pump thread and read by the launcher
+        # thread: guarded by _lock, signalled by _url_ready
+        self._lock = threading.Lock()
+        self._url = None
+        self._url_ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, name=f"replica-stderr-{idx}", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def url(self):
+        with self._lock:
+            return self._url
+
+    def _pump(self) -> None:
+        for line in self.proc.stderr:
+            m = URL_RE.search(line)
+            if m:
+                with self._lock:
+                    self._url = m.group(1)
+                self._url_ready.set()
+            sys.stderr.write(f"[replica {self.idx}] {line}")
+        self._url_ready.set()  # EOF: unblock a waiter even on crash
+
+    def wait_url(self, timeout: float):
+        self._url_ready.wait(timeout)
+        return self.url
+
+    def join_pump(self) -> None:
+        self._thread.join(timeout=10)
+
+
+def spawn_replica(args, idx: int) -> ReplicaProc:
+    cmd = [
+        sys.executable, os.path.join(REPO, "serve.py"),
+        "--ckpt", args.ckpt,
+        "--model", args.model,
+        "--http_port", "0",
+        "--http_host", args.host,
+        "--buckets", *[str(b) for b in args.buckets],
+        "--max_wait_ms", str(args.max_wait_ms),
+        "--deadline_ms", str(args.deadline_ms),
+        "--num_devices", str(args.replica_devices),
+        "--poll_s", str(args.poll_s),
+    ]
+    if args.aot_cache:
+        cmd += ["--aot_cache", args.aot_cache]
+    if args.watch:
+        cmd.append("--watch")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        cmd,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+    )
+    return ReplicaProc(idx, proc)
+
+
+def wait_healthy(replica: ReplicaProc, timeout: float) -> dict:
+    """Block until the replica's /healthz answers ok; returns the health
+    payload (compile counts ride it — the cold-start evidence)."""
+    from pytorch_cifar_tpu.serve.router import Replica, ReplicaError
+
+    url = replica.wait_url(timeout)
+    if url is None or replica.proc.poll() is not None:
+        raise SystemExit(
+            f"replica {replica.idx} exited rc={replica.proc.returncode} "
+            "before its frontend came up"
+        )
+    client = Replica(url, timeout_s=5.0)
+    deadline = time.monotonic() + timeout
+    try:
+        while time.monotonic() < deadline:
+            if replica.proc.poll() is not None:
+                raise SystemExit(
+                    f"replica {replica.idx} died during warmup "
+                    f"(rc={replica.proc.returncode})"
+                )
+            try:
+                status, health = client.request("GET", "/healthz")
+            except ReplicaError:
+                time.sleep(0.2)
+                continue
+            if status == 200:
+                return health
+            time.sleep(0.2)
+    finally:
+        client.close()
+    raise SystemExit(f"replica {replica.idx} never became healthy")
+
+
+def shutdown_replicas(replicas, timeout: float) -> list:
+    """SIGTERM every live replica (their drain signal), collect exit
+    codes; a replica the chaos drill SIGKILLed is already gone."""
+    for r in replicas:
+        if r.proc.poll() is None:
+            r.proc.send_signal(signal.SIGTERM)
+    codes = []
+    for r in replicas:
+        try:
+            r.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            r.proc.kill()
+            r.proc.wait()
+        # drain the replica's stdout (its one JSON line) and stderr pump
+        if r.proc.stdout is not None:
+            r.proc.stdout.read()
+        r.join_pump()
+        codes.append(r.proc.returncode)
+    return codes
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--model", default="ResNet18")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port", type=int, default=0,
+        help="router HTTP port (0 = ephemeral; the actual URL prints "
+        "on stderr)",
+    )
+    p.add_argument("--buckets", type=int, nargs="+", default=[1, 8, 32])
+    p.add_argument("--max_wait_ms", type=float, default=2.0)
+    p.add_argument(
+        "--deadline_ms", type=float, default=0.0,
+        help="per-replica queue-time bound; the router hedges a 504 to "
+        "a second replica",
+    )
+    p.add_argument(
+        "--replica_devices", type=int, default=1, dest="replica_devices",
+        help="devices per replica mesh (serve.py --num_devices)",
+    )
+    p.add_argument(
+        "--aot_cache", default="",
+        help="shared AOT executable cache dir: replica 0 populates it, "
+        "later replicas cold-start with compile_count == 0",
+    )
+    p.add_argument("--watch", action="store_true")
+    p.add_argument("--poll_s", type=float, default=1.0)
+    p.add_argument("--probe_s", type=float, default=0.5)
+    p.add_argument(
+        "--fail_after", type=int, default=2,
+        help="consecutive probe/dispatch failures before eviction",
+    )
+    # built-in HTTP loadgen (0 clients = serve until SIGTERM/SIGINT)
+    p.add_argument("--clients", type=int, default=0)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--images_max", type=int, default=8)
+    p.add_argument("--duration_s", type=float, default=0.0)
+    p.add_argument("--bulk_fraction", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=900.0)
+    args = p.parse_args()
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve.frontend import ServingFrontend
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+    from pytorch_cifar_tpu.serve.router import Router
+
+    # stage the fleet: replica 0 alone (it fills the AOT cache), then
+    # the rest in parallel (they import the cached executables)
+    replicas = [spawn_replica(args, 0)]
+    health0 = wait_healthy(replicas[0], args.timeout)
+    print(
+        f"==> replica 0 warm: compiles={health0.get('compiles')} "
+        f"aot_hits={health0.get('aot_cache_hits')}", file=sys.stderr,
+    )
+    replicas += [
+        spawn_replica(args, i) for i in range(1, args.replicas)
+    ]
+    healths = [health0] + [
+        wait_healthy(r, args.timeout) for r in replicas[1:]
+    ]
+    for r in replicas:
+        print(
+            f"==> replica {r.idx} pid={r.proc.pid} url={r.url}",
+            file=sys.stderr,
+        )
+
+    registry = MetricsRegistry()
+    router = Router(
+        [r.url for r in replicas],
+        registry=registry,
+        probe_s=args.probe_s,
+        fail_after=args.fail_after,
+    ).start()
+    frontend = ServingFrontend(
+        router, host=args.host, port=args.port, registry=registry
+    ).start()
+    print(f"==> router: serving on {frontend.url}", file=sys.stderr)
+
+    report = {}
+    try:
+        if args.clients > 0:
+            target = HttpTarget(frontend.url)
+            report = run_load(
+                target,
+                clients=args.clients,
+                requests_per_client=args.requests,
+                images_max=args.images_max,
+                seed=args.seed,
+                duration_s=args.duration_s or None,
+                bulk_fraction=args.bulk_fraction,
+            )
+        else:
+            stop = threading.Event()
+            signal.signal(signal.SIGTERM, lambda *a: stop.set())
+            signal.signal(signal.SIGINT, lambda *a: stop.set())
+            stop.wait(args.duration_s or None)
+    finally:
+        print("==> router: draining", file=sys.stderr)
+        frontend.stop()
+        router.stop()
+        replica_rcs = shutdown_replicas(replicas, timeout=60.0)
+
+    record = {
+        "harness": "router_run",
+        "replicas": args.replicas,
+        "model": args.model,
+        "router_url": frontend.url,
+        "replica_compiles": [h.get("compiles") for h in healths],
+        "replica_aot_hits": [h.get("aot_cache_hits") for h in healths],
+        "replica_cold_start_s": [h.get("cold_start_s") for h in healths],
+        "replica_rcs": replica_rcs,
+        **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in report.items()
+        },
+        "router": router.stats,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
