@@ -517,6 +517,141 @@ def run_ckpt(model: str, compute_dtype):
     return sync_ms / max(async_ms, 1e-9), extra
 
 
+def run_canary(model: str, compute_dtype):
+    """Canary promotion pipeline smoke (serve/canary.py, ROBUSTNESS.md
+    "canary promotion"). Three measurements ride one record:
+
+    - **promote latency** (the headline ``value``, ms): one staged
+      candidate's full vet-and-promote step — manifest-verified load,
+      weight swap into the canary engine, exact golden diff, atomic
+      republish into the live dir — driven inline via ``poll_once``.
+      The publish half alone rides as ``promote_ms_p50``
+      (``canary.promote_ms``).
+    - **the quarantine path**: a NaN-poisoned candidate must be rejected
+      (``rejected`` pinned at 1 — the drill-grade guarantee, smoke-sized).
+    - **shadow-tee overhead**: closed-loop load through the batcher with
+      the shadow tee armed (controller SHADOWING, worker running) vs
+      without — ``shadow_vs_plain`` is the client-side throughput ratio
+      (the tee costs one lock+append per request on the client path plus
+      background canary compute).
+    """
+    import tempfile
+
+    from pytorch_cifar_tpu import faults
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve import (
+        CanaryBudget,
+        GoldenSet,
+        InferenceEngine,
+        MicroBatcher,
+        PromotionController,
+    )
+    from pytorch_cifar_tpu.serve.loadgen import run_load
+    from pytorch_cifar_tpu.train.checkpoint import (
+        ensure_staging_dir,
+        save_checkpoint,
+    )
+
+    state = build_state(model, 8, compute_dtype)
+    jax.block_until_ready(state.params)
+
+    class _TeeTarget:
+        """run_load drives ``submit``; production tees at the backend
+        above the batcher (ShadowBackend), so this wrapper mirrors it
+        for the closed-loop protocol: the offer fires once the client's
+        result exists."""
+
+        def __init__(self, batcher, controller):
+            self.batcher = batcher
+            self.controller = controller
+            self.obs = getattr(batcher, "obs", None)
+
+        def submit(self, x, deadline_ms=None, priority="interactive"):
+            fut = self.batcher.submit(x, deadline_ms, priority)
+            controller = self.controller
+
+            class _F:
+                def result(self, timeout=None):
+                    out = fut.result(timeout)
+                    controller.offer(x, out, priority=priority)
+                    return out
+
+            return _F()
+
+    with tempfile.TemporaryDirectory(prefix="bench_canary_") as work:
+        live = os.path.join(work, "live")
+        staging = ensure_staging_dir(live)
+        save_checkpoint(live, state, epoch=1, best_acc=10.0)
+        reg = MetricsRegistry()
+        buckets = (8, 32)
+        engine = InferenceEngine.from_checkpoint(
+            live, model, buckets=buckets, compute_dtype=compute_dtype,
+            registry=reg,
+        )
+        canary_engine = InferenceEngine.from_checkpoint(
+            live, model, buckets=buckets, compute_dtype=compute_dtype
+        )
+        ctl = PromotionController(
+            canary_engine, staging, live,
+            golden=GoldenSet.random(64, seed=1),
+            # unlabeled golden + flip gate off: the regressed candidate
+            # is a stand-in for "legitimately different weights" here
+            budget=CanaryBudget(max_flip_frac=1.0),
+            shadow_fraction=1.0,
+            registry=reg,
+        )
+
+        # 1) promote latency: stage a finite, different-weights candidate
+        save_checkpoint(staging, state, epoch=2, best_acc=20.0)
+        faults.regress_checkpoint(staging, scale=0.5, seed=7)
+        t0 = time.perf_counter()
+        verdict = ctl.poll_once()
+        promote_wall_ms = (time.perf_counter() - t0) * 1e3
+        assert verdict == "promoted", f"candidate did not promote: {verdict}"
+
+        # 2) the quarantine path: a NaN'd candidate must be rejected
+        save_checkpoint(staging, state, epoch=3, best_acc=30.0)
+        faults.regress_checkpoint(staging, nan=True)
+        assert ctl.poll_once() == "quarantined"
+
+        # 3) shadow overhead A/B (plain first: engine warmup amortized)
+        batcher = MicroBatcher(engine, registry=reg)
+        plain = run_load(
+            batcher, clients=4, requests_per_client=16, images_max=8,
+            seed=0,
+        )
+        ctl.budget.min_shadow_requests = 10**9  # hold SHADOWING all load
+        save_checkpoint(staging, state, epoch=4, best_acc=40.0)
+        faults.regress_checkpoint(staging, scale=0.5, seed=9)
+        assert ctl.poll_once() == "shadowing"
+        ctl.start()  # shadow worker drains the tee concurrently
+        shadow = run_load(
+            _TeeTarget(batcher, ctl), clients=4, requests_per_client=16,
+            images_max=8, seed=0,
+        )
+        ctl.stop()
+        batcher.close()
+        status = ctl.status()
+        s = reg.summary()
+
+    extra = {
+        "promote_ms_p50": round(s.get("canary.promote_ms.p50", 0.0), 3),
+        "golden_ms_p50": round(s.get("canary.golden_ms.p50", 0.0), 3),
+        "promotions": int(status["promotions"]),
+        "rejected": int(status["rejected"]),
+        "plain_img_per_sec": round(plain["img_per_sec"], 3),
+        "shadow_img_per_sec": round(shadow["img_per_sec"], 3),
+        "shadow_vs_plain": round(
+            shadow["img_per_sec"] / max(plain["img_per_sec"], 1e-9), 4
+        ),
+        "shadow_requests": int(status["shadow"]["requests"]),
+        "shadow_rows": int(status["shadow"]["rows"]),
+        "shadow_errors": int(status["shadow"]["errors"]),
+        "load_failed": plain["failed"] + shadow["failed"],
+    }
+    return promote_wall_ms, extra
+
+
 def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
     """Serving-side north-star: closed-loop request latency + img/s
     through the full serve stack (bucket-compiled engine + micro-batcher;
@@ -955,6 +1090,13 @@ def main() -> int:
         "(ROBUSTNESS.md / SERVING.md); value = stall speedup (x)",
     )
     parser.add_argument(
+        "--canary", action="store_true",
+        help="measure the canary promotion pipeline (serve/canary.py, "
+        "ROBUSTNESS.md 'canary promotion'): staged-candidate "
+        "vet+promote latency (value, ms), the quarantine path, and "
+        "shadow-tee overhead vs a plain batcher",
+    )
+    parser.add_argument(
         "--chaos-smoke", action="store_true", dest="chaos_smoke",
         help="run one kill-mid-epoch -> resume cycle through "
         "tools/chaos_run.py and report RECOVERY TIME (seconds) in the "
@@ -980,6 +1122,7 @@ def main() -> int:
         or args.serve
         or args.serve_http
         or args.ckpt
+        or args.canary
         or args.config is not None
     ):
         # the scoreboard default: orchestrate fresh-process captures of the
@@ -1007,6 +1150,12 @@ def main() -> int:
         # hidden from the training thread at equal checkpoint bytes
         unit = "x"
         metric = f"ckpt_async_stall_{args.model}_{platform}"
+    elif args.canary:
+        value, extra = run_canary(args.model, compute_dtype)
+        # wall ms of one staged-candidate vet+promote step: lower =
+        # faster staging-to-live for a good checkpoint
+        unit = "ms"
+        metric = f"canary_promote_{args.model}_{platform}"
     elif args.serve:
         report = run_serve(args.model, args.batch, args.steps, compute_dtype)
         value = report["img_per_sec"]
@@ -1090,7 +1239,7 @@ def main() -> int:
         extra = {"obs": obs}
         name = f"train_throughput_{args.model}_b{args.batch}"
 
-    if not (args.pipeline or args.ckpt):
+    if not (args.pipeline or args.ckpt or args.canary):
         metric = f"{name}_{args.dtype}_{platform}"
     rec = core_record(metric, value, unit=unit)
     rec.update(extra)

@@ -123,6 +123,18 @@ class TrainConfig:
 
     # checkpointing (reference: main.py:136-148)
     output_dir: str = "./checkpoint"
+    # Checkpoint publish target (ROBUSTNESS.md "canary promotion"):
+    #   "live"    — publish into output_dir itself, the dir serving
+    #               replicas watch (the pre-pipeline behavior).
+    #   "staging" — publish EVERYTHING this trainer writes (best ckpt,
+    #               preemption save, rolling history) into
+    #               output_dir/staging/ instead; nothing reaches a
+    #               hot-reload watcher until the canary promotion
+    #               controller (serve/canary.py) vets the checkpoint and
+    #               republishes it into the live dir. --resume reads
+    #               staging too — the trainer's own newest state lives
+    #               there, promoted or not.
+    publish: str = "live"
     # Overlapped checkpoint writes (checkpoint.AsyncCheckpointWriter):
     #   "on"  — a save does only the device_get snapshot on the training
     #           thread; serialization + CRC + the fsync'd tmp+rename

@@ -13,6 +13,13 @@ each injection point is a read of an inert registry that tests and
 - :func:`truncate_file` / :func:`bitflip_file`: deterministic checkpoint
   corruption for the manifest-verified fallback restore path
   (``train/checkpoint.py``).
+- ``ckpt_regress`` (value = perturbation scale in PERCENT): the
+  checkpoint save path perturbs the snapshot's params before publishing,
+  so the committed file is *plausible but wrong* — finite weights, VALID
+  manifest, wrong logits. CRC catches torn/bitflipped files; only the
+  canary pipeline's output-level vetting (``serve/canary.py``) catches
+  this one. :func:`regress_checkpoint` is the offline equivalent for an
+  already-published file (``nan=True`` poisons instead of perturbing).
 
 Arming works two ways:
 
@@ -107,6 +114,18 @@ def nan_loss_step() -> Optional[int]:
     return int(v) if v is not True else 0
 
 
+def ckpt_regress_scale() -> Optional[float]:
+    """Perturbation scale of the armed ``ckpt_regress`` fault, or None
+    when inert. Armed values are PERCENT (``PCT_FAULTS`` carries ints):
+    ``ckpt_regress=100`` perturbs each float param leaf by ~1.0 of its
+    own std; a bare ``ckpt_regress`` means 100. Read by
+    ``save_checkpoint`` right after the device_get snapshot."""
+    v = get("ckpt_regress")
+    if v is None or v is False:
+        return None
+    return 1.0 if v is True else float(v) / 100.0
+
+
 def maybe_raise(name: str, exc: type = RuntimeError) -> None:
     """Raise ``exc`` if fault ``name`` is armed, consuming one unit of its
     ``times`` budget (a budget of 1 gives exactly one failure)."""
@@ -135,6 +154,71 @@ def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
     with open(path, "rb+") as f:
         f.truncate(keep)
     return keep
+
+
+def regress_checkpoint(
+    ckpt_dir: str,
+    name: str = "ckpt.msgpack",
+    scale: float = 1.0,
+    seed: int = 0,
+    nan: bool = False,
+) -> str:
+    """Rewrite checkpoint ``name`` in place as a PLAUSIBLE-BUT-WRONG
+    publish: every float param leaf perturbed by N(0, scale*std) noise
+    (or NaN-poisoned with ``nan=True``), and the sidecar manifest
+    RECOMPUTED so integrity verification still passes — the checkpoint
+    restores and serves cleanly, its outputs are just wrong. The failure
+    shape the canary pipeline exists to catch (ROBUSTNESS.md "canary
+    promotion"); :func:`bitflip_file` without the manifest fix covers
+    the CRC-visible class instead. Single-payload (v2) checkpoints only.
+
+    Imports flax/numpy lazily — this module stays importable before jax
+    initializes a backend; msgpack restore/serialize never touch one."""
+    import json
+
+    import numpy as np
+    from flax import serialization
+
+    from pytorch_cifar_tpu.train.checkpoint import (
+        _atomic_write,
+        meta_path,
+        payload_manifest,
+    )
+
+    path = os.path.join(ckpt_dir, name)
+    mpath = meta_path(ckpt_dir, name)
+    with open(mpath) as f:
+        meta = json.load(f)
+    if meta.get("shards"):
+        raise ValueError(
+            f"{path}: regress_checkpoint supports single-payload (v2) "
+            "checkpoints only"
+        )
+    with open(path, "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    rs = np.random.RandomState(seed)
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        arr = np.asarray(node)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return node
+        out = arr.copy()
+        if nan:
+            out.reshape(-1)[0] = np.nan  # propagates through every layer
+            return out
+        sd = float(arr.std()) or 1.0
+        return (arr + rs.normal(0.0, scale * sd, size=arr.shape)).astype(
+            arr.dtype
+        )
+
+    tree["params"] = walk(tree["params"])
+    payload = serialization.msgpack_serialize(tree)
+    _atomic_write(path, payload)
+    meta["manifest"] = payload_manifest(payload)
+    _atomic_write(mpath, json.dumps(meta).encode())
+    return path
 
 
 def bitflip_file(path: str, offset: Optional[int] = None) -> int:

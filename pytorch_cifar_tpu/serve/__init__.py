@@ -29,6 +29,13 @@ becomes a long-lived prediction service:
   frontend — ``serve.py --http_port`` runs one replica,
   ``tools/router_run.py`` runs the fleet (SERVING.md "HTTP frontend &
   router").
+- :mod:`~pytorch_cifar_tpu.serve.canary` closes the train→serve loop:
+  a :class:`~pytorch_cifar_tpu.serve.canary.PromotionController` vets
+  every checkpoint a ``--publish staging`` trainer commits — golden-batch
+  exact diffing plus a shadow-traffic soak on a one-replica canary —
+  and atomically promotes it to the live dir or quarantines it, so no
+  bad checkpoint ever reaches a fleet watcher (ROBUSTNESS.md "canary
+  promotion"; ``tools/pipeline_run.py`` runs the whole pipeline).
 
 See SERVING.md for the architecture and tuning knobs.
 """
@@ -39,6 +46,12 @@ from pytorch_cifar_tpu.serve.batcher import (  # noqa: F401
     DeadlineExceeded,
     MicroBatcher,
     QueueFull,
+)
+from pytorch_cifar_tpu.serve.canary import (  # noqa: F401
+    CanaryBudget,
+    GoldenSet,
+    PromotionController,
+    ShadowBackend,
 )
 from pytorch_cifar_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,

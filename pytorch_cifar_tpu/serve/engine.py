@@ -329,6 +329,14 @@ class InferenceEngine:
         else:
             self._weights = jax.device_put((params, batch_stats or {}))
 
+    def weights_host(self):
+        """Host-numpy copies of the served ``(params, batch_stats)``
+        trees — the rollback snapshot the canary promotion controller
+        swaps back to after rejecting a candidate (serve/canary.py)."""
+        import jax
+
+        return jax.device_get(self._weights)
+
     @staticmethod
     def _avals(tree):
         import jax

@@ -220,6 +220,14 @@ class BatcherBackend:
     def health(self) -> dict:
         eng = self.engine
         meta = getattr(eng, "checkpoint_meta", {}) or {}
+        if self.watcher is not None and self.watcher.last_meta:
+            # a hot reload swapped in a newer publish: its sidecar meta
+            # (epoch, best_acc, and — when the canary pipeline published
+            # it — the promotion stamp) is what this replica now serves
+            meta = self.watcher.last_meta
+        # promotion generation (serve/canary.py): stamped into the live
+        # sidecar by every canary promotion; None on a pre-pipeline dir
+        promo = meta.get("promotion") or {}
         out = {
             "status": "ok",
             "role": "replica",
@@ -227,6 +235,7 @@ class BatcherBackend:
             "engine_version": int(eng.version),
             "ckpt_epoch": meta.get("epoch"),
             "best_acc": meta.get("best_acc"),
+            "promotion_generation": promo.get("generation"),
             "compiles": int(eng.compile_count),
             "aot_cache_hits": int(eng.aot_cache_hits),
             "cold_start_s": round(float(eng.cold_start_s), 3),
@@ -237,6 +246,7 @@ class BatcherBackend:
         if self.watcher is not None:
             out["reloads"] = self.watcher.reloads
             out["reload_skipped"] = self.watcher.skipped
+            out["reload_quarantined"] = self.watcher.quarantined
         return out
 
 

@@ -41,7 +41,10 @@ from typing import Optional
 from pytorch_cifar_tpu.train.checkpoint import (
     CKPT_NAME,
     CheckpointCorrupt,
+    is_quarantined,
+    is_staging_dir,
     meta_path,
+    read_quarantine,
 )
 
 log = logging.getLogger(__name__)
@@ -71,6 +74,13 @@ class CheckpointWatcher:
         # polls that saw a torn/in-progress publish and deferred (the
         # checkpoint will be picked up complete on a later poll)
         self.skipped = 0
+        # publishes refused because a quarantine tombstone covers them
+        # (canary verdict, ROBUSTNESS.md "canary promotion") — unlike
+        # `skipped` these never become loadable: only a NEW publish is
+        self.quarantined = 0
+        # the watched dir itself is a staging dir: refuse every swap
+        # (logged once; the flag doubles as the once-latch)
+        self._staging_refused = False
         self.last_meta: dict = {}
         # obs registry (optional): the counters mirror the attributes
         # above so the serving exporter/Prometheus dump carries reload
@@ -117,21 +127,51 @@ class CheckpointWatcher:
             return None
         return (payload, sidecar)
 
+    def _count(self, event: str) -> None:
+        if self._obs is not None:
+            self._obs.counter(f"serve.reload.{event}").inc()
+
     def poll_once(self) -> bool:
         """One poll step: reload iff the file signature changed AND the
         manifest-verified load succeeds. Returns True when a swap
         happened. Split out so tests can drive the watcher without
         timing dependence."""
+        if is_staging_dir(self.ckpt_dir):
+            # a staging dir is the canary pipeline's INPUT: its
+            # checkpoints are unvetted by definition, so no matter how
+            # committed they look the watcher must never swap them in —
+            # only the promotion controller may republish them into a
+            # live dir (ROBUSTNESS.md "canary promotion")
+            with self._lock:
+                first = not self._staging_refused
+                self._staging_refused = True
+            if first:
+                log.warning(
+                    "watcher pointed at STAGING dir %s: refusing every "
+                    "hot reload (serve the live dir instead)",
+                    self.ckpt_dir,
+                )
+                self._count("refused_staging")
+            return False
         sig = self._signature()
         if sig is None or sig == self._last_sig:
             return False
         from pytorch_cifar_tpu.obs import trace
         from pytorch_cifar_tpu.serve.engine import load_checkpoint_trees
 
-        def count(event):
-            if self._obs is not None:
-                self._obs.counter(f"serve.reload.{event}").inc()
-
+        count = self._count
+        if is_quarantined(self.ckpt_dir, self.name):
+            tomb = read_quarantine(self.ckpt_dir, self.name) or {}
+            log.warning(
+                "refusing quarantined checkpoint %s (%s); keeping "
+                "current weights until a NEW publish lands",
+                self._path(), tomb.get("reason", "no reason recorded"),
+            )
+            with self._lock:
+                self.quarantined += 1
+                self._last_sig = sig  # only a new publish re-evaluates
+            count("quarantined")
+            return False
         try:
             params, stats, meta = load_checkpoint_trees(
                 self._path(),

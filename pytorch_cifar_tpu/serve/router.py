@@ -214,7 +214,21 @@ class Router:
         self._rr = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # canary shadow tee (serve/canary.py): when attached, every
+        # successfully answered request is OFFERED to the promotion
+        # controller — a lock+append into its bounded queue, never a
+        # canary compute, never an error on the client path
+        self._shadow = None
         self._g_healthy.set(len(self.replicas))
+
+    def attach_shadow(self, controller) -> None:
+        """Tee answered requests to a canary
+        :class:`~pytorch_cifar_tpu.serve.canary.PromotionController`:
+        ``offer(images, incumbent_logits, priority=...)`` is called with
+        the request AND the incumbent's answer (no second incumbent
+        pass), off the client response path. ``None`` detaches."""
+        with self._lock:
+            self._shadow = controller
 
     # -- replica selection + state transitions -------------------------
 
@@ -341,6 +355,13 @@ class Router:
                 out = self._dispatch(replica, body, timeout_s)
                 self._c_images.inc(int(x.shape[0]))
                 self._h_latency.observe((time.perf_counter() - t0) * 1e3)
+                with self._lock:
+                    shadow = self._shadow
+                if shadow is not None:
+                    # fire-and-forget: offer() enqueues (or drops) and
+                    # never raises — the client's bits and deadline are
+                    # already settled in `out`
+                    shadow.offer(x, out, priority=priority)
                 return out
             except QueueFull as e:
                 last_exc = e
@@ -405,8 +426,10 @@ class Router:
                 }
                 for r in self.replicas
             ]
+        with self._lock:
+            shadow = self._shadow
         healthy = sum(r["healthy"] for r in replicas)
-        return {
+        out = {
             "status": "ok" if healthy else "unavailable",
             "role": "router",
             "healthy_replicas": healthy,
@@ -415,6 +438,9 @@ class Router:
             "reinstated": int(self._c_reinstated.value),
             "hedged": int(self._c_hedged.value),
         }
+        if shadow is not None:
+            out["canary"] = shadow.status()
+        return out
 
     @property
     def stats(self) -> dict:

@@ -95,6 +95,153 @@ def meta_path(output_dir: str, name: str) -> str:
     return os.path.join(output_dir, os.path.splitext(name)[0] + ".json")
 
 
+# -- staging / quarantine / promotion (serve/canary.py pipeline) ---------
+
+STAGING_SUBDIR = "staging"
+STAGING_MARKER = ".staging"
+
+
+def staging_dir(output_dir: str) -> str:
+    """The staging subdirectory of ``output_dir`` — where a trainer
+    running under ``--publish staging`` commits its checkpoints for the
+    canary pipeline to vet. Never watched by serving replicas (the
+    hot-reload watcher refuses staging dirs outright); only the promotion
+    controller reads it (ROBUSTNESS.md "canary promotion")."""
+    return os.path.join(output_dir, STAGING_SUBDIR)
+
+
+def ensure_staging_dir(output_dir: str) -> str:
+    """Create the staging dir with its marker file. The marker is what
+    lets a watcher (or ckpt_inspect) recognize a staging dir it was
+    mistakenly pointed at, independent of the directory's name."""
+    path = staging_dir(output_dir)
+    os.makedirs(path, exist_ok=True)
+    marker = os.path.join(path, STAGING_MARKER)
+    if not os.path.exists(marker):
+        _atomic_write(
+            marker, b"staging checkpoint dir: never serve directly\n"
+        )
+    return path
+
+
+def is_staging_dir(path: str) -> bool:
+    """A dir is staging when it carries the marker file OR is literally
+    named like one — either way its checkpoints are unvetted by
+    definition and must never be hot-loaded into a serving engine."""
+    return os.path.exists(os.path.join(path, STAGING_MARKER)) or (
+        os.path.basename(os.path.abspath(path)) == STAGING_SUBDIR
+    )
+
+
+def quarantine_path(output_dir: str, name: str) -> str:
+    """Path of the quarantine tombstone sidecar for checkpoint ``name``."""
+    return os.path.join(
+        output_dir, os.path.splitext(name)[0] + ".quarantined.json"
+    )
+
+
+def publish_fingerprint(meta: dict) -> Optional[dict]:
+    """Identity of one committed publish, independent of format: the
+    whole-payload manifest (v2 ``manifest``, v3 ``total``) reduced to
+    crc32+size. Quarantine tombstones record it so a tombstone poisons
+    exactly ONE publish — a later (different) candidate committed under
+    the same file name evaluates fresh."""
+    man = (meta or {}).get("manifest") or (meta or {}).get("total")
+    if not man:
+        return None
+    return {
+        "crc32": int(man.get("crc32", -1)),
+        "size": int(man.get("size", -1)),
+    }
+
+
+def quarantine_checkpoint(
+    output_dir: str, name: str, reason: str, meta: Optional[dict] = None,
+    extra: Optional[dict] = None,
+) -> str:
+    """Write the tombstone sidecar marking the CURRENT publish of
+    ``name`` rejected (canary verdict, ROBUSTNESS.md "canary promotion").
+    The checkpoint files themselves are left in place as evidence; the
+    tombstone is what every reader (controller, watcher, ckpt_inspect)
+    keys on. One atomic write — a tombstone is never torn."""
+    if meta is None:
+        meta = _read_meta(output_dir, name)
+    rec = {
+        "reason": str(reason),
+        "epoch": meta.get("epoch"),
+        "best_acc": meta.get("best_acc"),
+        "fingerprint": publish_fingerprint(meta),
+        "at": time.time(),
+    }
+    rec.update(extra or {})
+    path = quarantine_path(output_dir, name)
+    _atomic_write(path, json.dumps(rec).encode())
+    return path
+
+
+def read_quarantine(output_dir: str, name: str) -> Optional[dict]:
+    """The tombstone record for ``name`` (None when absent/unreadable)."""
+    try:
+        with open(quarantine_path(output_dir, name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_quarantined(
+    output_dir: str, name: str, meta: Optional[dict] = None
+) -> bool:
+    """True when the CURRENT publish of ``name`` carries a matching
+    quarantine tombstone. A tombstone whose fingerprint differs from the
+    current sidecar's belongs to an older rejected publish and is inert
+    (the new candidate deserves a fresh verdict); a fingerprint-less
+    comparison (v1 sidecar, torn meta) stays quarantined — when in doubt,
+    never serve."""
+    tomb = read_quarantine(output_dir, name)
+    if tomb is None:
+        return False
+    fp = tomb.get("fingerprint")
+    if not fp:
+        return True
+    cur = publish_fingerprint(
+        meta if meta is not None else _read_meta(output_dir, name)
+    )
+    return cur is None or cur == fp
+
+
+def publish_checkpoint(
+    src_dir: str, dst_dir: str, name: str = CKPT_NAME,
+    extra_meta: Optional[dict] = None,
+) -> str:
+    """Atomically promote checkpoint ``name`` from ``src_dir`` into
+    ``dst_dir`` (the live dir a fleet's hot-reload watchers key on).
+
+    The payload is read VERIFIED from the source (v3 candidates are
+    reassembled from their committed shards), so a torn or corrupt
+    staging checkpoint can never be promoted; the destination is always
+    a single-payload format-v2 publish written payload first, sidecar
+    (the commit marker carrying the manifest) LAST — the discipline every
+    writer in this repo follows, so a watcher can never observe a torn
+    pair. ``extra_meta`` (e.g. the promotion-generation stamp) merges
+    into the destination sidecar.
+
+    Raises FileNotFoundError (candidate absent) or CheckpointCorrupt
+    like restore would — the promotion controller quarantines on the
+    latter."""
+    meta = _read_meta(src_dir, name)
+    payload = read_verified_payload(src_dir, name, meta)
+    os.makedirs(dst_dir, exist_ok=True)
+    out_meta = {
+        "epoch": meta.get("epoch"),
+        "best_acc": meta.get("best_acc"),
+        "manifest": payload_manifest(payload),
+    }
+    out_meta.update(extra_meta or {})
+    _atomic_write(os.path.join(dst_dir, name), payload)
+    _atomic_write(meta_path(dst_dir, name), json.dumps(out_meta).encode())
+    return os.path.join(dst_dir, name)
+
+
 def shard_name(name: str, index: int, num_shards: int) -> str:
     """On-disk name of byte-range shard ``index`` of ``name`` (format v3).
 
@@ -386,6 +533,26 @@ class AsyncCheckpointWriter:
 
 # -- save ----------------------------------------------------------------
 
+def _regress_leaf(scale: float, seed: int = 0xC0FFEE):
+    """Leaf perturber for the ckpt_regress fault: add N(0, scale*std)
+    noise to every float leaf (std floor 1.0 keeps zero-initialized
+    leaves perturbed too). Values stay finite — the checkpoint loads,
+    verifies, and serves; only its OUTPUTS are wrong. Deterministic per
+    leaf shape+order via one shared stream."""
+    rs = np.random.RandomState(seed)
+
+    def perturb(a):
+        arr = np.asarray(a)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return a
+        sd = float(arr.std()) or 1.0
+        return (arr + rs.normal(0.0, scale * sd, size=arr.shape)).astype(
+            arr.dtype
+        )
+
+    return perturb
+
+
 def _write_unsharded(
     output_dir: str, name: str, payload: bytes, epoch: int,
     best_acc: float, keep_last_n: int,
@@ -619,6 +786,21 @@ def save_checkpoint(
                     "opt_state": state.opt_state,
                     "step": state.step,
                 }
+            )
+        # chaos injection point (inert unless armed): a ckpt_regress
+        # fault perturbs the snapshot's params so the PUBLISHED
+        # checkpoint is plausible-but-wrong — finite weights, valid
+        # manifest, wrong outputs — the failure class only the canary
+        # pipeline's output-level vetting can catch (torn/bitflipped
+        # files are CRC-visible; this is not). ROBUSTNESS.md.
+        regress = faults.ckpt_regress_scale()
+        if regress:
+            log.warning(
+                "ckpt_regress fault armed: perturbing %s params "
+                "(scale %.2f) before publish", name, regress,
+            )
+            host_state["params"] = jax.tree_util.tree_map(
+                _regress_leaf(regress), host_state["params"]
             )
 
         def commit():

@@ -54,6 +54,7 @@ from pytorch_cifar_tpu.train.checkpoint import (
     LAST_NAME,
     AsyncCheckpointWriter,
     best_checkpoint_order,
+    ensure_staging_dir,
     meta_path,
     remove_stale_last,
     restore_checkpoint,
@@ -283,6 +284,21 @@ class Trainer:
 
         self.start_epoch = 0
         self.best_acc = 0.0
+        # Checkpoint publish target (ROBUSTNESS.md "canary promotion"):
+        # under --publish staging EVERY checkpoint this trainer writes —
+        # best, preemption, history — lands in output_dir/staging/ (the
+        # canary pipeline's input; the serving watcher refuses it), and
+        # resume reads the same dir, so the trainer's own state never
+        # depends on what the promotion controller has vetted so far.
+        if config.publish not in ("live", "staging"):
+            raise ValueError(
+                f"publish must be live/staging, got {config.publish!r}"
+            )
+        self.ckpt_dir = (
+            ensure_staging_dir(config.output_dir)
+            if config.publish == "staging"
+            else config.output_dir
+        )
         if config.resume or config.evaluate:
             # training resume wants the *newest* state: the preemption save
             # (last.msgpack) only when it is actually ahead of the best-params
@@ -294,16 +310,16 @@ class Trainer:
             # history) on ANY corruption — a truncated last.msgpack no
             # longer kills the resume (ROBUSTNESS.md).
             names = (
-                best_checkpoint_order(config.output_dir)
+                best_checkpoint_order(self.ckpt_dir)
                 if config.evaluate
-                else self._resume_order(config.output_dir)
+                else self._resume_order(self.ckpt_dir)
             )
             state, self.start_epoch, self.best_acc = restore_checkpoint(
-                config.output_dir, state, names=names, registry=self.obs
+                self.ckpt_dir, state, names=names, registry=self.obs
             )
             log.info(
                 "resumed from %s: epoch %d, best_acc %.2f",
-                config.output_dir,
+                self.ckpt_dir,
                 self.start_epoch,
                 self.best_acc,
             )
@@ -565,9 +581,9 @@ class Trainer:
             self._ckpt_writer.flush()
         try:
             state, _, _ = restore_checkpoint(
-                self.config.output_dir,
+                self.ckpt_dir,
                 self.state,
-                names=newest_checkpoint_order(self.config.output_dir),
+                names=newest_checkpoint_order(self.ckpt_dir),
                 registry=self.obs,
             )
         except FileNotFoundError:
@@ -859,7 +875,7 @@ class Trainer:
             log.info("Saving.. (best acc %.2f%%)", acc)
             if self._ckpt_writer is None:
                 save_checkpoint(
-                    self.config.output_dir,
+                    self.ckpt_dir,
                     self.state if snap_state is None else snap_state,
                     epoch,
                     self.best_acc,
@@ -897,7 +913,7 @@ class Trainer:
         actually on disk."""
         epoch = snap[1]
         save_checkpoint(
-            self.config.output_dir, snap[0], epoch, snap[2],
+            self.ckpt_dir, snap[0], epoch, snap[2],
             keep_last_n=self.config.keep_last_n,
             registry=self.obs,
             writer=self._ckpt_writer,
@@ -1076,7 +1092,7 @@ class Trainer:
                         epoch,
                     )
                     save_checkpoint(
-                        cfg.output_dir,
+                        self.ckpt_dir,
                         self.state,
                         epoch,
                         self.best_acc,
@@ -1093,7 +1109,7 @@ class Trainer:
                 # completed normally: a leftover preemption save is now
                 # stale; remove it so a routine relaunch with --resume
                 # cannot roll training back (process-0 writes only)
-                remove_stale_last(cfg.output_dir)
+                remove_stale_last(self.ckpt_dir)
         finally:
             # A crash mid-epoch must not lose the PREVIOUS epoch's
             # completed eval + best-checkpoint gate (its results are

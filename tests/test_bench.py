@@ -259,6 +259,27 @@ def test_bench_ckpt_mode_prints_one_json_line():
     assert cs["no_cache_s"] > 0 and cs["warm_cache_s"] > 0
 
 
+def test_bench_canary_mode_prints_one_json_line():
+    """--canary (the promotion-pipeline PR): staged-candidate
+    vet+promote latency in ms as the headline `value`, the quarantine
+    path pinned (exactly one NaN candidate rejected), and the shadow-tee
+    overhead A/B riding the same driver-contract record."""
+    rec, _ = run_bench(["--canary", "--model", "LeNet"])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"] == "canary_promote_LeNet_cpu", rec["metric"]
+    assert rec["unit"] == "ms"
+    assert rec["value"] > 0  # vet+promote wall time
+    assert rec["promote_ms_p50"] > 0  # the atomic republish half
+    assert rec["golden_ms_p50"] > 0  # the exact-diff half
+    assert rec["promotions"] == 1
+    assert rec["rejected"] == 1  # the NaN candidate was quarantined
+    assert rec["plain_img_per_sec"] > 0 and rec["shadow_img_per_sec"] > 0
+    assert rec["shadow_vs_plain"] > 0
+    assert rec["shadow_requests"] > 0 and rec["shadow_rows"] > 0
+    assert rec["shadow_errors"] == 0
+    assert rec["load_failed"] == 0
+
+
 def test_bench_serve_http_mode_prints_one_json_line():
     """--serve-http (the HTTP frontend PR): the same driver contract
     through the full network path — img/s `value` over loopback HTTP,

@@ -1,0 +1,487 @@
+"""Canary promotion pipeline tier-1 tests (serve/canary.py;
+ROBUSTNESS.md "canary promotion").
+
+What is pinned here:
+- the promotion state machine: a good candidate promotes (live sidecar
+  gains the generation stamp, commit-marker-last), a NaN'd / regressed /
+  CRC-corrupt / wrong-model candidate quarantines (tombstone sidecar) and
+  the canary rolls back BIT-exactly to the incumbent;
+- exactness: golden diffing is a count, not an estimate — identical
+  weights yield identical_rows == n, and post-rollback canary outputs
+  equal pre-candidate outputs bit for bit;
+- budget semantics: labeled golden data judges by exact accuracy (flips
+  are diagnostics — an improving candidate flips freely), unlabeled data
+  judges by flip fraction; shadow-soak budget exhaustion rolls back;
+- the shadow tee never changes client responses (bit-identical through
+  ShadowBackend, even when the canary engine is broken) and never leaks
+  threads on stop;
+- the reload watcher refuses staging dirs and quarantined publishes;
+- the trainer's --publish staging routes every checkpoint into
+  output_dir/staging (and resumes from there).
+
+The end-to-end drill (train child + HTTP serving + staged bad
+checkpoints under load) is ``tools/chaos_run.py --mode canary``, covered
+by the slow suite in test_chaos.py.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_cifar_tpu import faults
+from pytorch_cifar_tpu.train.checkpoint import (
+    CKPT_NAME,
+    ensure_staging_dir,
+    is_quarantined,
+    is_staging_dir,
+    meta_path,
+    publish_checkpoint,
+    quarantine_checkpoint,
+    read_quarantine,
+    save_checkpoint,
+    staging_dir,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _state(seed=0):
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    model = create_model("LeNet")
+    tx = make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2)
+    return create_train_state(model, jax.random.PRNGKey(seed), tx)
+
+
+def _engine(ckpt_dir):
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    return InferenceEngine.from_checkpoint(
+        str(ckpt_dir), "LeNet", buckets=(4, 8), compute_dtype=jnp.float32
+    )
+
+
+def _pipeline(tmp_path, seed=0, epoch=1, best_acc=10.0, **ctl_kw):
+    """live dir with an incumbent checkpoint + staging dir + a
+    controller whose canary engine holds the incumbent weights."""
+    from pytorch_cifar_tpu.serve import CanaryBudget, GoldenSet, \
+        PromotionController
+
+    live = str(tmp_path / "live")
+    save_checkpoint(live, _state(seed), epoch=epoch, best_acc=best_acc)
+    staging = ensure_staging_dir(live)
+    golden = ctl_kw.pop("golden", GoldenSet.random(16, seed=3))
+    budget = ctl_kw.pop("budget", CanaryBudget(max_flip_frac=1.0))
+    ctl = PromotionController(
+        _engine(live), staging, live, golden=golden, budget=budget,
+        **ctl_kw,
+    )
+    return live, staging, ctl
+
+
+# -- state machine: promote / quarantine ---------------------------------
+
+
+def test_good_candidate_promotes_with_generation_stamp(tmp_path):
+    """A finite candidate within budget promotes: the live dir gains the
+    candidate's payload with a promotion-generation stamp in the sidecar
+    (commit marker written last), and a freshly loaded engine serves the
+    candidate's weights bit-identically to the canary's."""
+    live, staging, ctl = _pipeline(tmp_path)
+    assert ctl.poll_once() is None  # empty staging: nothing to do
+
+    save_checkpoint(staging, _state(7), epoch=2, best_acc=20.0)
+    assert ctl.poll_once() == "promoted"
+    assert ctl.generation == 1 and ctl.state == "promoted"
+    with open(meta_path(live, CKPT_NAME)) as f:
+        meta = json.load(f)
+    assert meta["epoch"] == 2
+    assert meta["promotion"]["generation"] == 1
+    # the promoted live checkpoint serves exactly the canary's bits
+    x = np.random.RandomState(0).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    assert np.array_equal(_engine(live).predict(x), ctl.engine.predict(x))
+    # settled staging: no spurious re-evaluation
+    assert ctl.poll_once() is None
+
+
+def test_identical_candidate_diffs_exactly_zero(tmp_path):
+    """Bit-identity makes the golden diff a COUNT: a candidate with the
+    incumbent's own weights must show identical_rows == n and 0 flips."""
+    live, staging, ctl = _pipeline(tmp_path, seed=5)
+    save_checkpoint(staging, _state(5), epoch=2, best_acc=20.0)
+    assert ctl.poll_once() == "promoted"
+    verdict = ctl._candidate["golden"]
+    assert verdict["flips"] == 0
+    assert verdict["identical_rows"] == len(ctl.golden)
+
+
+def test_nan_candidate_quarantined_and_rolled_back_bit_exact(tmp_path):
+    """A NaN'd checkpoint (valid manifest — CRC cannot catch it) must be
+    caught by the golden finiteness gate; the canary rolls back to
+    weights bit-identical to pre-candidate and the live dir is
+    untouched."""
+    live, staging, ctl = _pipeline(tmp_path)
+    x = np.random.RandomState(1).randint(
+        0, 256, size=(5, 32, 32, 3)
+    ).astype(np.uint8)
+    pre = ctl.engine.predict(x)
+    with open(os.path.join(live, CKPT_NAME), "rb") as f:
+        live_bytes = f.read()
+
+    save_checkpoint(staging, _state(9), epoch=2, best_acc=30.0)
+    faults.regress_checkpoint(staging, nan=True)
+    assert ctl.poll_once() == "quarantined"
+    tomb = read_quarantine(staging, CKPT_NAME)
+    assert "nonfinite" in tomb["reason"]
+    assert is_quarantined(staging, CKPT_NAME)
+    with open(os.path.join(live, CKPT_NAME), "rb") as f:
+        assert f.read() == live_bytes  # fleet never saw a byte of it
+    assert np.array_equal(ctl.engine.predict(x), pre)  # exact rollback
+
+
+def test_regressed_candidate_quarantined_by_flip_budget(tmp_path):
+    """Unlabeled golden data: the exact flip-fraction gate catches a
+    plausible-but-wrong (finite, CRC-valid) checkpoint."""
+    from pytorch_cifar_tpu.serve import CanaryBudget
+
+    live, staging, ctl = _pipeline(
+        tmp_path, budget=CanaryBudget(max_flip_frac=0.5)
+    )
+    save_checkpoint(staging, _state(0), epoch=2, best_acc=30.0)
+    faults.regress_checkpoint(staging, scale=2.0)
+    assert ctl.poll_once() == "quarantined"
+    assert "argmax flipped" in read_quarantine(staging, CKPT_NAME)["reason"]
+
+
+def test_labeled_golden_judges_by_accuracy_not_flips(tmp_path):
+    """With labels, exact accuracy is the regression gate and flips are
+    diagnostics: a candidate that flips nearly every answer but IMPROVES
+    accuracy must promote; one that collapses accuracy must quarantine
+    even though the flip budget is wide open."""
+    from pytorch_cifar_tpu.serve import CanaryBudget, GoldenSet
+
+    # golden labels = candidate B's own argmax, so B scores ~100% while
+    # the incumbent A scores ~chance — a maximal legitimate improvement
+    rs = np.random.RandomState(2)
+    images = rs.randint(0, 256, size=(32, 32, 32, 3)).astype(np.uint8)
+    b_dir = str(tmp_path / "b")
+    save_checkpoint(b_dir, _state(8), epoch=2, best_acc=50.0)
+    labels = np.argmax(_engine(b_dir).predict(images), axis=-1)
+
+    live, staging, ctl = _pipeline(
+        tmp_path,
+        golden=GoldenSet(images, labels),
+        budget=CanaryBudget(max_flip_frac=0.01, acc_margin=1.0),
+    )
+    publish_checkpoint(b_dir, staging)
+    assert ctl.poll_once() == "promoted"  # flips galore, accuracy up
+
+    # now a candidate whose accuracy collapses: quarantined by the
+    # accuracy gate (reason names accuracy, not flips)
+    save_checkpoint(staging, _state(8), epoch=3, best_acc=60.0)
+    faults.regress_checkpoint(staging, scale=2.0)
+    assert ctl.poll_once() == "quarantined"
+    assert "accuracy" in read_quarantine(staging, CKPT_NAME)["reason"]
+
+
+def test_corrupt_candidate_quarantined_after_settle_grace(tmp_path):
+    """A bitflipped payload (manifest mismatch) gets ONE poll of grace —
+    a publish racing the read looks identical — then quarantines once
+    the same signature still fails."""
+    live, staging, ctl = _pipeline(tmp_path)
+    save_checkpoint(staging, _state(4), epoch=2, best_acc=20.0)
+    faults.bitflip_file(os.path.join(staging, CKPT_NAME))
+    assert ctl.poll_once() is None  # grace: might be mid-publish
+    assert ctl.poll_once() == "quarantined"  # settled and still corrupt
+    assert "corrupt" in read_quarantine(staging, CKPT_NAME)["reason"]
+
+
+def test_quarantined_publish_never_retried_new_candidate_is(tmp_path):
+    """A tombstone pins exactly one publish: polls after the verdict are
+    no-ops, but a NEW candidate under the same name evaluates fresh."""
+    live, staging, ctl = _pipeline(tmp_path)
+    save_checkpoint(staging, _state(9), epoch=2, best_acc=30.0)
+    faults.regress_checkpoint(staging, nan=True)
+    assert ctl.poll_once() == "quarantined"
+    rejected_before = int(ctl.status()["rejected"])
+    assert ctl.poll_once() is None  # judged: not re-vetted
+    assert int(ctl.status()["rejected"]) == rejected_before
+
+    save_checkpoint(staging, _state(6), epoch=3, best_acc=40.0)
+    assert ctl.poll_once() == "promoted"  # stale tombstone is inert
+
+
+def test_wrong_model_candidate_quarantined(tmp_path):
+    """A checkpoint whose trees do not match the compiled programs'
+    avals (different model trained into the staging dir) quarantines at
+    the swap gate."""
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    live, staging, ctl = _pipeline(tmp_path)
+    wrong = create_train_state(
+        create_model("VGG11"), jax.random.PRNGKey(0),
+        make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2),
+    )
+    save_checkpoint(staging, wrong, epoch=2, best_acc=20.0)
+    assert ctl.poll_once() == "quarantined"
+    assert "wrong-model" in read_quarantine(staging, CKPT_NAME)["reason"]
+
+
+# -- shadow tee -----------------------------------------------------------
+
+
+def test_shadow_budget_exhaustion_rolls_back(tmp_path):
+    """min_shadow_requests holds a golden-passing candidate in
+    `shadowing`; when the shadowed traffic diverges past the shadow flip
+    budget, the controller rolls back and quarantines."""
+    from pytorch_cifar_tpu.serve import CanaryBudget
+
+    live, staging, ctl = _pipeline(
+        tmp_path,
+        budget=CanaryBudget(
+            max_flip_frac=1.0,  # golden gate open: shadow must catch it
+            min_shadow_requests=3,
+            max_shadow_flip_frac=0.2,
+        ),
+    )
+    incumbent = _engine(live)
+    x = np.random.RandomState(5).randint(
+        0, 256, size=(4, 32, 32, 3)
+    ).astype(np.uint8)
+    pre = incumbent.predict(x)
+
+    save_checkpoint(staging, _state(3), epoch=2, best_acc=30.0)
+    faults.regress_checkpoint(staging, scale=2.0)
+    assert ctl.poll_once() == "shadowing"
+    assert ctl.poll_once() is None  # soak incomplete: no verdict yet
+
+    ctl.shadow_fraction = 1.0
+    for _ in range(3):
+        assert ctl.offer(x, incumbent.predict(x)) is True
+    assert ctl.process_shadow_queue() == 3
+    assert ctl.poll_once() == "quarantined"
+    tomb = read_quarantine(staging, CKPT_NAME)
+    assert "shadow argmax flipped" in tomb["reason"]
+    assert np.array_equal(ctl.engine.predict(x), pre)  # exact rollback
+
+
+def test_shadow_soak_promotes_within_budget(tmp_path):
+    """The happy soak: enough shadowed requests within the divergence
+    budget promote the candidate (an identical-weights candidate
+    diverges on exactly zero rows — and its shadow answers are
+    BIT-identical, pinned via the identical counter)."""
+    from pytorch_cifar_tpu.serve import CanaryBudget
+
+    live, staging, ctl = _pipeline(
+        tmp_path, seed=2,
+        budget=CanaryBudget(max_flip_frac=1.0, min_shadow_requests=2),
+    )
+    incumbent = _engine(live)
+    x = np.random.RandomState(6).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+
+    save_checkpoint(staging, _state(2), epoch=2, best_acc=30.0)
+    assert ctl.poll_once() == "shadowing"
+    ctl.shadow_fraction = 1.0
+    for _ in range(2):
+        ctl.offer(x, incumbent.predict(x))
+    assert ctl.process_shadow_queue() == 2
+    assert ctl.poll_once() == "promoted"
+    s = ctl.status()["shadow"]
+    assert s["requests"] == 2 and s["flip_rows"] == 0
+    assert s["identical"] == 2  # same weights -> same bits, exactly
+
+
+def test_shadow_tee_never_changes_client_response(tmp_path):
+    """ShadowBackend: the client's logits are bit-identical to the plain
+    engine path even while the tee samples every request — and even when
+    the canary engine ERRORS, the failure stays on the canary side
+    (shadow.errors counts it; the client never sees it)."""
+    from pytorch_cifar_tpu.serve import (
+        BatcherBackend,
+        MicroBatcher,
+        ShadowBackend,
+    )
+
+    live, staging, ctl = _pipeline(tmp_path, shadow_fraction=1.0)
+    engine = _engine(live)
+    batcher = MicroBatcher(engine)
+    backend = ShadowBackend(BatcherBackend(engine, batcher), ctl)
+
+    save_checkpoint(staging, _state(4), epoch=2, best_acc=20.0)
+    from pytorch_cifar_tpu.serve import CanaryBudget
+
+    ctl.budget = CanaryBudget(max_flip_frac=1.0, min_shadow_requests=10)
+    assert ctl.poll_once() == "shadowing"
+
+    x = np.random.RandomState(7).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    try:
+        out = backend.predict(x)
+        assert np.array_equal(out, engine.predict(x))  # bit-identical
+        assert ctl.process_shadow_queue() == 1
+
+        # break the canary outright: the client path must not notice
+        def boom(images):
+            raise RuntimeError("canary replica down")
+
+        ctl.engine.predict = boom
+        out2 = backend.predict(x)
+        assert np.array_equal(out2, out)
+        assert ctl.process_shadow_queue() == 1
+        assert ctl.status()["shadow"]["errors"] == 1
+        # bulk traffic is never sampled (the tee models user-facing risk)
+        assert ctl.offer(x, out, priority="bulk") is False
+        # /healthz carries the canary block through the backend wrapper
+        assert backend.health()["canary"]["state"] == "shadowing"
+    finally:
+        batcher.close()
+
+
+def test_controller_stop_joins_all_threads(tmp_path):
+    """start() launches a poll thread + shadow worker; stop() joins BOTH
+    even with shadow work still queued — no thread leak on drain."""
+    live, staging, ctl = _pipeline(tmp_path, shadow_fraction=1.0)
+    from pytorch_cifar_tpu.serve import CanaryBudget
+
+    ctl.budget = CanaryBudget(max_flip_frac=1.0, min_shadow_requests=100)
+    save_checkpoint(staging, _state(4), epoch=2, best_acc=20.0)
+    assert ctl.poll_once() == "shadowing"
+
+    before = {t.name for t in threading.enumerate()}
+    ctl.start()
+    x = np.random.RandomState(8).randint(
+        0, 256, size=(2, 32, 32, 3)
+    ).astype(np.uint8)
+    inc = ctl.engine.predict(x)
+    for _ in range(20):
+        ctl.offer(x, inc)
+    ctl.stop()
+    after = {t.name for t in threading.enumerate()}
+    assert not {n for n in after - before if n.startswith("canary-")}
+    ctl.stop()  # idempotent
+
+
+# -- reload watcher: staging + quarantine refusal (satellite) ------------
+
+
+def test_watcher_refuses_staging_dir(tmp_path):
+    """A watcher mistakenly pointed at a staging dir must never swap,
+    no matter how committed its checkpoints look."""
+    from pytorch_cifar_tpu.serve import CheckpointWatcher
+
+    live = str(tmp_path)
+    save_checkpoint(live, _state(0), epoch=1, best_acc=10.0)
+    eng = _engine(live)
+    staging = ensure_staging_dir(live)
+    assert is_staging_dir(staging)
+    save_checkpoint(staging, _state(7), epoch=2, best_acc=20.0)
+
+    watcher = CheckpointWatcher(eng, staging, poll_s=3600)
+    assert watcher.poll_once() is False
+    assert watcher.poll_once() is False
+    assert eng.version == 0 and watcher.reloads == 0
+
+
+def test_watcher_never_loads_quarantined_publish(tmp_path):
+    """The regression pin for the satellite: a quarantined publish —
+    fully committed, manifest-valid — is refused by the watcher until a
+    NEW publish lands (which then swaps normally)."""
+    from pytorch_cifar_tpu.serve import CheckpointWatcher
+
+    live = str(tmp_path)
+    save_checkpoint(live, _state(0), epoch=1, best_acc=10.0)
+    eng = _engine(live)
+    watcher = CheckpointWatcher(eng, live, poll_s=3600)
+
+    save_checkpoint(live, _state(7), epoch=2, best_acc=20.0)
+    quarantine_checkpoint(live, CKPT_NAME, "canary said no")
+    assert watcher.poll_once() is False
+    assert watcher.quarantined == 1 and eng.version == 0
+    assert watcher.poll_once() is False  # sig remembered: no re-read
+
+    # a NEW publish (different fingerprint) makes the tombstone inert
+    save_checkpoint(live, _state(5), epoch=3, best_acc=30.0)
+    assert watcher.poll_once() is True
+    assert eng.version == 1 and watcher.last_meta["epoch"] == 3
+
+
+# -- trainer staging publish (satellite) ---------------------------------
+
+
+def test_trainer_staging_publish_routes_all_checkpoints(tmp_path):
+    """--publish staging: every checkpoint the trainer writes lands in
+    output_dir/staging (marker present), the live dir stays empty, and
+    --resume reads the staged state back."""
+    from pytorch_cifar_tpu.config import TrainConfig
+    from pytorch_cifar_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model="LeNet", epochs=1, batch_size=64, eval_batch_size=64,
+        synthetic_data=True, synthetic_train_size=256,
+        synthetic_test_size=128, lr=0.02, amp=False, log_every=1000,
+        output_dir=str(tmp_path), publish="staging",
+    )
+    Trainer(cfg).fit()
+    staged = staging_dir(str(tmp_path))
+    assert is_staging_dir(staged)
+    assert os.path.isfile(os.path.join(staged, CKPT_NAME))
+    assert not os.path.isfile(os.path.join(str(tmp_path), CKPT_NAME))
+
+    tr = Trainer(
+        TrainConfig(**{**cfg.__dict__, "resume": True, "epochs": 2})
+    )
+    assert tr.start_epoch == 1  # resumed from the STAGED checkpoint
+    assert tr.ckpt_dir == staged
+
+    with pytest.raises(ValueError, match="publish"):
+        Trainer(TrainConfig(**{**cfg.__dict__, "publish": "nonsense"}))
+
+
+def test_healthz_reports_promotion_generation_after_reload(tmp_path):
+    """BatcherBackend /healthz: after the watcher hot-loads a PROMOTED
+    checkpoint, the health payload carries the promotion generation and
+    the promoted epoch (what the chaos drill keys on)."""
+    from pytorch_cifar_tpu.serve import (
+        BatcherBackend,
+        CheckpointWatcher,
+        MicroBatcher,
+    )
+
+    live, staging, ctl = _pipeline(tmp_path)
+    engine = _engine(live)
+    batcher = MicroBatcher(engine)
+    watcher = CheckpointWatcher(engine, live, poll_s=3600)
+    backend = BatcherBackend(engine, batcher, watcher=watcher)
+    try:
+        assert backend.health()["promotion_generation"] is None
+
+        save_checkpoint(staging, _state(7), epoch=2, best_acc=20.0)
+        assert ctl.poll_once() == "promoted"
+        assert watcher.poll_once() is True
+        h = backend.health()
+        assert h["promotion_generation"] == 1
+        assert h["ckpt_epoch"] == 2
+        assert h["reload_quarantined"] == 0
+    finally:
+        batcher.close()

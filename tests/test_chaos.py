@@ -168,3 +168,35 @@ def test_router_drill_sigkill_replica_under_load(tmp_path):
     assert rec["p99_post_ms"] <= rec["p99_budget_ms"]
     assert rec["failed_during_kill"] <= max(4, rec["requests"] // 20)
     assert rec["router_rc"] == 0
+
+
+def test_canary_drill_bad_checkpoints_contained_good_promotes(tmp_path):
+    """--mode canary (ROBUSTNESS.md "canary promotion"): under sustained
+    mixed-priority HTTP load, NaN'd + bitflipped + regressed checkpoints
+    staged into the pipeline must ALL be quarantined in canary (fleet
+    /predict bit-identical to pre-drill throughout, promotion generation
+    unmoved, zero client-visible errors), and a genuinely better
+    checkpoint must then auto-promote (generation + served epoch
+    advance, the watcher hot-loads it) — the pipeline exits 0.
+
+    The drill's own sizes override run_chaos's smaller defaults (last
+    flag wins): the promotion phase needs enough training signal that
+    checkpoint B is a GENUINE improvement over A (the drill hard-fails
+    early otherwise, rather than 'promote' a no-op candidate)."""
+    rec = run_chaos(
+        "canary", tmp_path,
+        extra=("--train-size", "512", "--test-size", "256"),
+    )
+    assert rec["match"] is True
+    assert rec["bad_candidates_contained"] is True
+    assert rec["rejected"] == 3 and rec["promotions"] == 1
+    for verdict in rec["verdicts"].values():
+        assert verdict["quarantined"] is True
+        assert verdict["fleet_bits_identical"] is True
+        assert verdict["served_epoch"] == rec["epoch_incumbent"]
+    assert rec["promoted"] is True
+    assert rec["final_epoch"] == rec["epoch_candidate"]
+    assert rec["final_generation"] == 1
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    assert rec["bulk_requests"] > 0
+    assert rec["pipeline_rc"] == 0

@@ -192,6 +192,71 @@ def test_history_rolls_and_serves_as_fallback(tmp_path):
     _params_equal(states[3].params, restored.params)
 
 
+# -- ckpt_regress: the plausible-but-wrong checkpoint fault --------------
+
+
+def test_ckpt_regress_fault_publishes_valid_but_wrong_checkpoint(tmp_path):
+    """The canary drill's raw material: with ckpt_regress armed (the
+    PCT_FAULTS value is a percent scale), save_checkpoint publishes a
+    checkpoint whose manifest VERIFIES — restore succeeds with no
+    fallback — but whose params are finite noise around the real ones.
+    CRC catches torn/bitflipped files; only output-level vetting
+    (serve/canary.py) catches this class."""
+    state = _lenet_state()
+    faults.inject("ckpt_regress", 100)  # percent: scale 1.0
+    assert faults.ckpt_regress_scale() == 1.0
+    save_checkpoint(str(tmp_path), state, epoch=1, best_acc=10.0)
+    faults.clear()
+
+    restored, start_epoch, _ = restore_checkpoint(
+        str(tmp_path), _lenet_state(seed=4)
+    )
+    assert start_epoch == 2  # manifest verified: no fallback, no raise
+    diffs = []
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state.params)),
+        jax.tree_util.tree_leaves(jax.device_get(restored.params)),
+    ):
+        b = np.asarray(b)
+        assert np.isfinite(b).all()  # finite — plausible
+        diffs.append(float(np.max(np.abs(np.asarray(a) - b))))
+    assert max(diffs) > 0.01  # ...but wrong
+
+
+def test_regress_checkpoint_offline_rewrites_manifest(tmp_path):
+    """faults.regress_checkpoint (offline equivalent): the rewritten
+    payload still verifies against its RECOMPUTED manifest, params are
+    perturbed-but-finite — and nan=True plants a non-finite param while
+    keeping the file restorable (the canary finiteness gate's target)."""
+    state = _lenet_state()
+    save_checkpoint(str(tmp_path), state, epoch=3, best_acc=30.0)
+    faults.regress_checkpoint(str(tmp_path), scale=1.0, seed=5)
+    restored, start_epoch, _ = restore_checkpoint(
+        str(tmp_path), _lenet_state(seed=8)
+    )
+    assert start_epoch == 4
+    leaves = jax.tree_util.tree_leaves(jax.device_get(restored.params))
+    assert all(np.isfinite(np.asarray(p)).all() for p in leaves)
+    orig = jax.tree_util.tree_leaves(jax.device_get(state.params))
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(orig, leaves)
+    )
+
+    faults.regress_checkpoint(str(tmp_path), nan=True)
+    restored, _, _ = restore_checkpoint(str(tmp_path), _lenet_state(seed=8))
+    leaves = jax.tree_util.tree_leaves(jax.device_get(restored.params))
+    assert any(not np.isfinite(np.asarray(p)).all() for p in leaves)
+
+    # sharded (v3) checkpoints are out of scope, loudly
+    save_checkpoint(
+        str(tmp_path), state, epoch=4, best_acc=40.0, name=LAST_NAME,
+        num_shards=2,
+    )
+    with pytest.raises(ValueError, match="single-payload"):
+        faults.regress_checkpoint(str(tmp_path), name=LAST_NAME)
+
+
 # -- divergence sentinel -------------------------------------------------
 
 

@@ -777,3 +777,67 @@ def test_ckpt_inspect_verifies_v2_and_v3_and_flags_corruption(tmp_path):
 
     # not-a-directory is a usage error (exit 2)
     assert _inspect(tmp_path / "nope").returncode == 2
+
+
+def test_ckpt_inspect_quarantine_and_staging_awareness(tmp_path):
+    """Canary-pipeline awareness (ROBUSTNESS.md "canary promotion"): a
+    quarantine tombstone in a STAGING dir is routine evidence (exit 0,
+    reported); the same tombstone covering the current publish of a
+    non-staging dir pointed at as LIVE is an operator error (exit 2);
+    a stale tombstone (older rejected publish) is inert; the promotion
+    generation stamp is surfaced."""
+    import jax
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import (
+        ensure_staging_dir,
+        publish_checkpoint,
+        quarantine_checkpoint,
+        save_checkpoint,
+    )
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    def mk_state(seed):
+        return create_train_state(
+            create_model("LeNet"), jax.random.PRNGKey(seed),
+            make_optimizer(lr=0.1, t_max=2, steps_per_epoch=2),
+        )
+
+    live = tmp_path / "live"
+    staging = ensure_staging_dir(str(live))
+    save_checkpoint(staging, mk_state(0), 1, 10.0)
+    quarantine_checkpoint(staging, "ckpt.msgpack", "nonfinite logits")
+
+    # staging dir: tombstone reported, exit 0 (the canary did its job)
+    r = _inspect(staging, "--json")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    assert rep["staging"] is True
+    assert rep["quarantined"] == ["ckpt.msgpack"]
+    assert rep["quarantined_as_live"] is False
+    q = rep["checkpoints"][0]["quarantined"]
+    assert q["active"] is True and "nonfinite" in q["reason"]
+
+    # the same quarantined publish in a non-staging dir = exit 2
+    save_checkpoint(str(live), mk_state(0), 1, 10.0)
+    quarantine_checkpoint(str(live), "ckpt.msgpack", "canary said no")
+    r = _inspect(live, "--json")
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    assert rep["staging"] is False
+    assert rep["quarantined_as_live"] is True
+    assert "QUARANTINED" in _inspect(live).stdout
+
+    # a NEW publish makes the tombstone stale: back to exit 0, and the
+    # promotion-generation stamp (publish_checkpoint) is surfaced
+    save_checkpoint(str(live), mk_state(3), 2, 20.0, name="ckpt.msgpack")
+    publish_checkpoint(
+        str(live), str(live), extra_meta={"promotion": {"generation": 7}}
+    )
+    r = _inspect(live, "--json")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    assert rep["quarantined"] == []
+    assert rep["checkpoints"][0]["promotion_generation"] == 7
+    assert rep["checkpoints"][0]["quarantined"]["active"] is False

@@ -37,6 +37,17 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
              planted — tools/ckpt_inspect.py must flag it, the resume
              must fall back past it (no torn v3 ever restored), and the
              final state must still match the reference run.
+  canary   — promotion-pipeline drill (ROBUSTNESS.md "canary
+             promotion"): a serve-only pipeline (tools/pipeline_run.py)
+             serves checkpoint A from the live dir under sustained
+             mixed-priority HTTP load while nan / bitflipped / regressed
+             candidates are staged one after another — every bad one
+             must be caught in canary (quarantine tombstone, fleet
+             /predict BIT-IDENTICAL to pre-drill, generation unmoved,
+             zero client-visible errors) — and then a genuinely better
+             checkpoint B must auto-promote (live epoch/generation
+             advance, the watcher hot-loads it) with zero failed client
+             requests across the whole drill.
   router   — fleet drill (SERVING.md "HTTP frontend & router"): a
              2-replica fleet behind tools/router_run.py serves sustained
              mixed-priority HTTP load; one replica is SIGKILLed
@@ -55,6 +66,7 @@ Usage:
   python tools/chaos_run.py --mode serve --serve-devices 8
   python tools/chaos_run.py --mode ckpt
   python tools/chaos_run.py --mode router
+  python tools/chaos_run.py --mode canary
 
 Subprocess-only: this driver never initializes a jax backend (the child
 runs own the device); comparisons read the msgpack checkpoints directly.
@@ -590,6 +602,310 @@ def router_drill(args, work: str) -> dict:
     }
 
 
+def canary_drill(args, work: str) -> dict:
+    """The promotion-pipeline drill (module docstring).
+
+    Phases:
+      0. train checkpoint A (epochs=E) and B (epochs=E+2, same seed: the
+         deterministic continuation, so B's best_acc >= A's); publish A
+         into the live dir; start ``pipeline_run.py --epochs 0`` (serve +
+         canary, empty staging) and record the fleet's pre-drill
+         /predict bits.
+      1-3. under sustained mixed-priority load, stage a NaN'd B, a
+         bitflipped B, and a weight-regressed B: each must be
+         quarantined in canary — tombstone lands, live dir signature
+         unmoved, /predict bit-identical to phase 0, promotion
+         generation unchanged.
+      4. stage the real B: it must promote — live sidecar carries B's
+         epoch + the next generation, the watcher hot-loads it (healthz
+         ckpt_epoch tracks), and /predict switches to B's answers.
+      5. drain (SIGTERM): pipeline_run must exit 0 with rejected == 3,
+         promotions == 1, and ZERO failed client requests.
+    """
+    import shutil
+    import threading
+    import urllib.request
+
+    from pytorch_cifar_tpu import faults
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget
+    from pytorch_cifar_tpu.train.checkpoint import (
+        ensure_staging_dir,
+        publish_checkpoint,
+        quarantine_path,
+        read_quarantine,
+    )
+
+    dir_a = os.path.join(work, "ckpt_a")
+    dir_b = os.path.join(work, "ckpt_b")
+    live = os.path.join(work, "pipeline")
+    os.makedirs(live, exist_ok=True)
+    staging = ensure_staging_dir(live)
+
+    # B must be a GENUINE improvement over A or the promotion phase
+    # proves nothing: both runs share one cosine schedule (t_max) so A is
+    # an exact prefix of B's trajectory and B's extra epochs can only
+    # find a better best; the default lr is raised to leave accuracy
+    # headroom at these drill sizes (0.02 barely moves off chance)
+    t_max = args.epochs + 3
+    args_a = argparse.Namespace(
+        **{**vars(args), "lr": 0.05 if args.lr == 0.02 else args.lr}
+    )
+    args_b = argparse.Namespace(
+        **{**vars(args_a), "epochs": args.epochs + 3}
+    )
+    extra = ("--cosine_t_max", str(t_max))
+    print(f"==> [canary] training checkpoint A -> {dir_a}", file=sys.stderr)
+    run_to_completion(
+        train_cmd(args_a, dir_a, extra=extra), child_env(), args.timeout
+    )
+    print(
+        f"==> [canary] training checkpoint B (+3 epochs) -> {dir_b}",
+        file=sys.stderr,
+    )
+    run_to_completion(
+        train_cmd(args_b, dir_b, extra=extra), child_env(), args.timeout
+    )
+    epoch_a = load_meta(dir_a)["epoch"]
+    epoch_b = load_meta(dir_b)["epoch"]
+    if epoch_b <= epoch_a or compare(dir_a, dir_b)["max_abs_diff"] == 0.0:
+        raise SystemExit(
+            f"checkpoint B (best epoch {epoch_b}) is not a genuine "
+            f"improvement over A (best epoch {epoch_a}); rerun with "
+            "--epochs/--lr that leave accuracy headroom"
+        )
+    publish_checkpoint(dir_a, live)
+
+    print("==> [canary] pipeline up (serve-only)", file=sys.stderr)
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "tools", "pipeline_run.py"),
+            "--ckpt", live,
+            "--model", args.model,
+            "--epochs", "0",
+            "--train-size", str(args.train_size),
+            "--test-size", str(args.test_size),
+            "--buckets", "1", "4", "8",
+            "--poll_s", "0.2",
+            "--golden", "eval",
+            "--shadow_fraction", "0.5",
+            "--acc_margin", "2.0",
+        ],
+        env=child_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+    out_err = _wait_for_stderr(proc, "pipeline: serving on", args.timeout)
+    url = re.search(r"pipeline: serving on (\S+)", out_err).group(1)
+    drain_t = threading.Thread(
+        target=lambda: [sys.stderr.write(ln) for ln in proc.stderr],
+        name="pipeline-stderr-drain", daemon=True,
+    )
+    drain_t.start()
+
+    def healthz():
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            return json.load(r)
+
+    probe = np.random.RandomState(11).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+
+    def predict_bits():
+        return HttpTarget(url).submit(probe).result()
+
+    pre = predict_bits()
+    h0 = healthz()
+    gen0 = h0.get("promotion_generation")
+
+    # sustained mixed-priority load for the whole drill (failures in
+    # `failed` are client-visible — the drill demands zero)
+    stop_load = threading.Event()
+    load_counts = {"requests": 0, "failed": 0, "bulk": 0}
+    load_lock = threading.Lock()
+
+    def load_client(cid):
+        target = HttpTarget(url)
+        rs = np.random.RandomState(100 + cid)
+        while not stop_load.is_set():
+            n = int(rs.randint(1, 5))
+            x = rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+            bulk = rs.uniform() < 0.3
+            with load_lock:
+                load_counts["bulk"] += 1 if bulk else 0
+            try:
+                target.submit(
+                    x, priority="bulk" if bulk else "interactive"
+                ).result()
+                with load_lock:
+                    load_counts["requests"] += 1
+            except Exception:
+                if not stop_load.is_set():
+                    with load_lock:
+                        load_counts["failed"] += 1
+        target.close()
+
+    load_threads = [
+        threading.Thread(target=load_client, args=(i,), daemon=True)
+        for i in range(3)
+    ]
+    for t in load_threads:
+        t.start()
+
+    def stage(corrupt=None):
+        """Publish B's checkpoint into staging, optionally corrupted
+        first in a scratch copy (corruption must land BEFORE the staging
+        commit — the canary polls continuously)."""
+        scratch = os.path.join(work, "scratch")
+        shutil.rmtree(scratch, ignore_errors=True)
+        os.makedirs(scratch)
+        publish_checkpoint(dir_b, scratch)
+        if corrupt is not None:
+            corrupt(scratch)
+        if corrupt is bitflip:
+            # bitflipped payload no longer matches its manifest, so the
+            # verified promote path cannot move it: publish raw (payload
+            # first, sidecar last), exactly what a buggy writer would do
+            for name in ("ckpt.msgpack", "ckpt.json"):
+                src, dst = (
+                    os.path.join(scratch, name), os.path.join(staging, name)
+                )
+                tmp = dst + ".tmp"
+                shutil.copyfile(src, tmp)
+                with open(tmp, "rb") as f:
+                    os.fsync(f.fileno())
+                os.replace(tmp, dst)
+        else:
+            publish_checkpoint(scratch, staging)
+
+    def bitflip(d):
+        faults.bitflip_file(os.path.join(d, "ckpt.msgpack"))
+
+    def wait_for_tombstone(tag, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        path = quarantine_path(staging, "ckpt.msgpack")
+        while time.monotonic() < deadline:
+            # the drill deleted the previous phase's tombstone, so ANY
+            # tombstone here is this phase's verdict
+            tomb = read_quarantine(staging, "ckpt.msgpack")
+            if tomb is not None:
+                return tomb
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"pipeline_run died (rc={proc.returncode}) during "
+                    f"{tag}"
+                )
+            time.sleep(0.2)
+        raise SystemExit(f"timed out waiting for {tag} quarantine ({path})")
+
+    verdicts = {}
+    phases = [
+        ("nan", lambda d: faults.regress_checkpoint(d, nan=True)),
+        ("bitflip", bitflip),
+        ("regress", lambda d: faults.regress_checkpoint(d, scale=2.0)),
+    ]
+    for tag, corrupt in phases:
+        # clear the previous tombstone so "a tombstone exists" is
+        # unambiguous evidence for THIS phase
+        try:
+            os.remove(quarantine_path(staging, "ckpt.msgpack"))
+        except OSError:
+            pass
+        print(f"==> [canary] staging {tag} candidate", file=sys.stderr)
+        stage(corrupt)
+        tomb = wait_for_tombstone(tag)
+        h = healthz()
+        bits_ok = bool(np.array_equal(predict_bits(), pre))
+        verdicts[tag] = {
+            "quarantined": True,
+            "reason": tomb.get("reason"),
+            "fleet_bits_identical": bits_ok,
+            "served_epoch": h.get("ckpt_epoch"),
+            "generation": h.get("promotion_generation"),
+        }
+        print(
+            f"==> [canary] {tag}: quarantined ({tomb.get('reason')!r}), "
+            f"fleet bits identical={bits_ok}", file=sys.stderr,
+        )
+
+    print("==> [canary] staging the GOOD candidate (B)", file=sys.stderr)
+    stage()
+    deadline = time.monotonic() + 60.0
+    promoted = False
+    while time.monotonic() < deadline:
+        h = healthz()
+        # promotion evidence: the generation stamp appears AND the
+        # watcher hot-loaded B (healthz epoch tracks the live sidecar)
+        if (
+            h.get("promotion_generation") not in (None, gen0)
+            and h.get("ckpt_epoch") == epoch_b
+        ):
+            promoted = True
+            break
+        if proc.poll() is not None:
+            raise SystemExit(
+                f"pipeline_run died (rc={proc.returncode}) before the "
+                "good candidate promoted"
+            )
+        time.sleep(0.2)
+    post = predict_bits()
+    h_final = healthz()
+
+    print("==> [canary] draining", file=sys.stderr)
+    stop_load.set()
+    for t in load_threads:
+        t.join(timeout=30)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=args.timeout)
+    drain_t.join(timeout=10)
+    rec_run = None
+    for ln in out.splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                rec_run = json.loads(ln)
+            except ValueError:
+                continue
+    if rec_run is None:
+        raise SystemExit("pipeline_run printed no JSON record")
+
+    bad_contained = all(
+        v["quarantined"]
+        and v["fleet_bits_identical"]
+        and v["served_epoch"] == epoch_a
+        and v["generation"] == gen0
+        for v in verdicts.values()
+    )
+    ok = (
+        proc.returncode == 0
+        and bad_contained
+        and promoted
+        and h_final.get("ckpt_epoch") == epoch_b
+        and h_final.get("promotion_generation") not in (None, gen0)
+        and not np.array_equal(post, pre)  # B's weights actually serve
+        and rec_run["rejected"] == 3
+        and rec_run["promotions"] == 1
+        and load_counts["requests"] > 0
+        and load_counts["failed"] == 0
+        and load_counts["bulk"] > 0
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "canary",
+        "match": ok,
+        "epoch_incumbent": epoch_a,
+        "epoch_candidate": epoch_b,
+        "bad_candidates_contained": bad_contained,
+        "verdicts": verdicts,
+        "promoted": promoted,
+        "final_epoch": h_final.get("ckpt_epoch"),
+        "final_generation": h_final.get("promotion_generation"),
+        "rejected": rec_run["rejected"],
+        "promotions": rec_run["promotions"],
+        "requests": load_counts["requests"],
+        "failed": load_counts["failed"],
+        "bulk_requests": load_counts["bulk"],
+        "pipeline_rc": proc.returncode,
+    }
+
+
 def _inspect(ckpt_dir: str) -> int:
     """tools/ckpt_inspect.py verdict for ``ckpt_dir`` (exit code)."""
     r = subprocess.run(
@@ -730,7 +1046,7 @@ def main() -> int:
         "--mode",
         choices=(
             "sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt",
-            "router",
+            "router", "canary",
         ),
         default="sigterm",
     )
@@ -776,11 +1092,12 @@ def main() -> int:
 
     work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
 
-    if args.mode in ("serve", "ckpt", "router"):
+    if args.mode in ("serve", "ckpt", "router", "canary"):
         record = {
             "serve": serve_drill,
             "ckpt": ckpt_drill,
             "router": router_drill,
+            "canary": canary_drill,
         }[args.mode](args, work)
         print(json.dumps(record))
         if record["match"] and not args.out:

@@ -9,8 +9,18 @@ size entries plus the whole-payload manifest. Orphan shards (a torn
 publish whose commit marker never landed — invisible to restore by
 construction) are reported as warnings, not corruption.
 
+Canary-pipeline awareness (ROBUSTNESS.md "canary promotion"): quarantine
+tombstones (``<stem>.quarantined.json``) are surfaced per checkpoint, the
+report says whether the dir is a STAGING dir (marker file / name), and
+live sidecars show their promotion generation. A quarantined checkpoint
+inside a staging dir is routine evidence (the canary did its job); the
+same tombstone in a dir being used as LIVE means a rejected checkpoint is
+one watcher poll away from serving — that is an operator error, reported
+with exit code 2.
+
 Exit codes: 0 = every committed checkpoint verifies; 1 = corruption found
-(a restore would have to fall back past it); 2 = usage/IO error.
+(a restore would have to fall back past it); 2 = usage/IO error, or a
+QUARANTINED checkpoint in a non-staging (live) dir.
 
 Usage:
   python tools/ckpt_inspect.py ./checkpoint
@@ -67,18 +77,35 @@ def _verify_bytes(path, manifest):
 
 def inspect_candidate(ckpt_dir, name):
     """One checkpoint candidate -> report dict (see module docstring)."""
-    from pytorch_cifar_tpu.train.checkpoint import meta_path
+    from pytorch_cifar_tpu.train.checkpoint import (
+        is_quarantined,
+        meta_path,
+        read_quarantine,
+    )
 
     meta = _load_json(meta_path(ckpt_dir, name)) or {}
     payload_path = os.path.join(ckpt_dir, name)
     shards = meta.get("shards")
+    promo = (meta.get("promotion") or {}) if isinstance(meta, dict) else {}
     rep = {
         "name": name,
         "epoch": meta.get("epoch"),
         "best_acc": meta.get("best_acc"),
+        "promotion_generation": promo.get("generation"),
+        "quarantined": None,
         "problems": [],
         "shards": [],
     }
+    # quarantine tombstone (canary verdict): active only when its
+    # fingerprint matches the CURRENT publish — a stale tombstone from an
+    # earlier rejected candidate is reported as inert
+    tomb = read_quarantine(ckpt_dir, name)
+    if tomb is not None:
+        rep["quarantined"] = {
+            "active": is_quarantined(ckpt_dir, name, meta),
+            "reason": tomb.get("reason"),
+            "epoch": tomb.get("epoch"),
+        }
     if shards:
         rep["format"] = 3
         parts = []
@@ -123,13 +150,20 @@ def inspect_candidate(ckpt_dir, name):
 
 
 def inspect_dir(ckpt_dir):
-    from pytorch_cifar_tpu.train.checkpoint import history_names
+    from pytorch_cifar_tpu.train.checkpoint import (
+        history_names,
+        is_staging_dir,
+    )
 
     # candidates: every non-shard sidecar, plus manifest-less v1 payloads
     names = set()
     for p in glob.glob(os.path.join(ckpt_dir, "*.json")):
         base = os.path.basename(p)
-        if ".shard" in base or base.endswith(".aotx.json"):
+        if (
+            ".shard" in base
+            or base.endswith(".aotx.json")
+            or base.endswith(".quarantined.json")
+        ):
             continue
         names.add(os.path.splitext(base)[0] + ".msgpack")
     for p in glob.glob(os.path.join(ckpt_dir, "*.msgpack")):
@@ -157,12 +191,23 @@ def inspect_dir(ckpt_dir):
         n: history_names(ckpt_dir, n) for n in primaries
     }
     corrupt = [r["name"] for r in reports if not r["ok"]]
+    staging = is_staging_dir(ckpt_dir)
+    quarantined = [
+        r["name"]
+        for r in reports
+        if (r.get("quarantined") or {}).get("active")
+    ]
     return {
         "dir": ckpt_dir,
+        "staging": staging,
         "checkpoints": reports,
         "orphan_shards": orphans,
         "history": history,
         "corrupt": corrupt,
+        "quarantined": quarantined,
+        # a rejected checkpoint sitting in a LIVE dir is one watcher poll
+        # from serving: the operator error this tool exists to catch
+        "quarantined_as_live": bool(quarantined) and not staging,
         "ok": not corrupt,
     }
 
@@ -181,20 +226,40 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps(report))
     else:
+        if report["staging"]:
+            print("STAGING dir (canary pipeline input — never serve "
+                  "directly)")
         for r in report["checkpoints"]:
             status = "OK" if r["ok"] else "CORRUPT"
             extra = (
                 f" ({len(r['shards'])} shards)" if r["shards"] else ""
             )
+            if r.get("promotion_generation") is not None:
+                extra += f" [promotion gen {r['promotion_generation']}]"
             print(
                 f"{r['name']}: format v{r['format']}, epoch "
                 f"{r['epoch']}{extra} — {status}"
             )
             for p in r["problems"]:
                 print(f"  ! {p}")
+            q = r.get("quarantined")
+            if q:
+                kind = "QUARANTINED" if q["active"] else (
+                    "stale tombstone (older rejected publish)"
+                )
+                print(f"  ! {kind}: {q.get('reason')}")
         for o in report["orphan_shards"]:
             print(f"orphan shard (torn publish, invisible to restore): {o}")
-        print("verdict:", "OK" if report["ok"] else "CORRUPT")
+        if report["quarantined_as_live"]:
+            print(
+                "verdict: QUARANTINED-AS-LIVE — a rejected checkpoint "
+                "sits in a non-staging dir "
+                f"({', '.join(report['quarantined'])})"
+            )
+        else:
+            print("verdict:", "OK" if report["ok"] else "CORRUPT")
+    if report["quarantined_as_live"]:
+        return 2
     return 0 if report["ok"] else 1
 
 
