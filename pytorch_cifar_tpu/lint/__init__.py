@@ -14,6 +14,11 @@ so regressions are caught at review time; this package is that layer.
   graph, cross-module call graph, reachability views (hot paths, thread
   entries, externally-traced closures), and the dp.py donation table
   derived from dp.py's own AST.
+- :mod:`pytorch_cifar_tpu.lint.locks` — the lock-effect analysis riding
+  that call graph: per-function held-lock sets, whole-project held-set
+  propagation, and the lock-order graph behind the concurrency-protocol
+  rules (lock-order-inversion, blocking-under-lock,
+  cond-wait-discipline, lock-leak).
 - :mod:`pytorch_cifar_tpu.lint.rules` — the rules themselves, each
   grounded in a failure mode this repo has actually hit (the catalog with
   one real-world example per rule is STATIC_ANALYSIS.md).

@@ -215,6 +215,28 @@ class _Project:
     def donating_wrapper(self, path: str, qual: str):
         return self.graph().resolve_donating_wrapper(path, qual)
 
+    def lock_analysis(self):
+        return self.graph().locks()
+
+    def metric_doc_names(self):
+        """The metric names OBSERVABILITY.md's tables document, or None
+        when the doc cannot be located (fixture trees without a repo
+        root: the metric-name-drift rule then stays silent)."""
+        if getattr(self, "_metric_docs", None) is None:
+            self._metric_docs = (False, None)
+            if self.repo_root:
+                doc = os.path.join(self.repo_root, "OBSERVABILITY.md")
+                if os.path.isfile(doc):
+                    from pytorch_cifar_tpu.lint.rules import (
+                        parse_metric_doc_names,
+                    )
+
+                    with open(doc, encoding="utf-8") as f:
+                        self._metric_docs = (
+                            True, parse_metric_doc_names(f.read())
+                        )
+        return self._metric_docs[1]
+
     def config_fields(self) -> Dict[str, set]:
         """{'TrainConfig': {field/property names}, 'ServeConfig': {...}};
         empty dict when config.py cannot be located (standalone fixture
@@ -534,4 +556,84 @@ def json_report(
         "counts": summarize(findings),
         "findings": [f.to_json() for f in findings],
         "stale_baseline": list(stale),
+    }
+
+
+def sarif_report(findings: List[Finding]) -> dict:
+    """SARIF 2.1.0 (the `--sarif` CLI mode): the schema code-review
+    tooling (GitHub code scanning, VS Code SARIF viewers) renders
+    inline. Open findings are level `error`; suppressed/baselined ones
+    ride along with a `suppressions` entry so the tooling shows them as
+    reviewed, not hides them. The content fingerprint doubles as the
+    SARIF partial fingerprint, so alert identity survives line moves
+    exactly like the baseline does."""
+    from pytorch_cifar_tpu.lint.rules import RULES
+
+    rules_meta = [
+        {
+            "id": r.name,
+            "shortDescription": {"text": r.summary},
+        }
+        for r in RULES
+    ] + [
+        {
+            "id": SUPPRESSION_RULE,
+            "shortDescription": {
+                "text": "malformed graftcheck noqa comment"
+            },
+        },
+        {
+            "id": PARSE_RULE,
+            "shortDescription": {"text": "file does not parse"},
+        },
+    ]
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": "error" if f.status == "open" else "note",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/"),
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": f.col + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        if f.fingerprint:
+            res["partialFingerprints"] = {
+                "graftcheck/v1": f.fingerprint,
+            }
+        if f.suppressed:
+            res["suppressions"] = [
+                {"kind": "inSource", "justification": f.suppress_reason}
+            ]
+        elif f.baselined:
+            res["suppressions"] = [{"kind": "external"}]
+        results.append(res)
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "informationUri": "STATIC_ANALYSIS.md",
+                        "rules": rules_meta,
+                    }
+                },
+                "results": results,
+            }
+        ],
     }

@@ -1,0 +1,843 @@
+"""graftcheck lock-effect analysis: who holds what, and what happens then.
+
+PRs 6-10 turned this repo into a heavily threaded serving fleet — lock
+and condition sites across the frontend handler threads, the router's
+probe thread, the batcher lanes, the canary poll+shadow workers, and the
+async checkpoint writer — and every one of those PRs shipped at least
+one hand-found threading bug. The whole-project call graph (PR 8) sees
+*which* code runs on which thread; this module closes its documented
+known-limit by computing *what happens while a lock is held*:
+
+- **Lock identity.** Every ``threading.Lock/RLock/Condition`` the tree
+  constructs is keyed by where it lives: ``(module.Class, attr)`` for
+  ``self._lock`` attributes, ``(module, name)`` for module-level locks,
+  ``(module:function, name)`` for function-locals closed over by
+  workers. ``Event`` is tracked (its ``wait`` matters below) but is not
+  a lock.
+- **Per-function lock summaries.** A block-structured walk of every def
+  computes, flow-sensitively per statement: which locks are acquired
+  (``with self._lock:``, explicit ``acquire()``/``release()``), the
+  held-set at every resolved call site, every *blocking* call
+  (``join()``, bare ``queue.get()``, socket/HTTP I/O, ``subprocess``,
+  ``jax.device_get``/``block_until_ready``, unbounded ``wait()``), and
+  every ``Condition`` ``wait``/``notify`` site.
+- **Whole-project propagation.** Held-sets flow through the PR 8
+  cross-module call graph: a callee inherits the union of its callers'
+  held-sets at their call sites (``*_locked`` methods of a one-lock
+  class are assumed entered with that lock held — the repo's own
+  caller-holds-the-lock convention), and each function's transitively
+  *acquired* set flows back up to order edges at the call site.
+- **The lock-order graph.** Acquiring B while holding A is the edge
+  A→B, whether the acquisition is lexical (nested ``with``) or hiding
+  three calls deep in another module. A cycle is the deadlock shape:
+  two call paths that take the same locks in opposite order only need
+  one bad interleaving.
+
+Rules in :mod:`pytorch_cifar_tpu.lint.rules` consume this through
+``ctx.project.lock_analysis()``: ``lock-order-inversion``,
+``blocking-under-lock``, ``cond-wait-discipline`` and ``lock-leak``.
+Pure stdlib ``ast``; resolution stays conservative (an unresolvable
+receiver contributes nothing) — the self-run must not cry wolf.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pytorch_cifar_tpu.lint.project import (
+    FuncNode,
+    ModuleInfo,
+    qualname,
+    walk_no_nested_funcs,
+)
+
+# ctor qualname -> kind; Event is deliberately "event", not a lock: its
+# wait() is a blocking primitive but holding no one's critical section
+_CTOR_KINDS = {
+    "threading.Lock": "lock",
+    "Lock": "lock",
+    "threading.RLock": "rlock",
+    "RLock": "rlock",
+    "threading.Condition": "cond",
+    "Condition": "cond",
+    "threading.Event": "event",
+    "Event": "event",
+}
+_LOCK_KINDS = frozenset({"lock", "rlock", "cond"})
+
+# blocking calls: the stall-under-lock shapes this repo has actually
+# paid for (a frontend handler or the canary controller frozen behind a
+# lock). Matched conservatively — see _classify_blocking.
+_BLOCKING_SIMPLE = {
+    "jax.device_get": "jax.device_get (a blocking D2H sync)",
+    "device_get": "device_get (a blocking D2H sync)",
+    "urllib.request.urlopen": "urlopen (network I/O)",
+    "request.urlopen": "urlopen (network I/O)",
+    "urlopen": "urlopen (network I/O)",
+    "socket.create_connection": "socket connect (network I/O)",
+}
+_BLOCKING_SUBPROCESS = frozenset({
+    "run", "call", "check_call", "check_output", "Popen",
+})
+# attribute calls that block regardless of receiver type
+_BLOCKING_ATTRS = frozenset({
+    "getresponse", "accept", "recv", "recvfrom", "sendall", "connect",
+    "communicate", "block_until_ready",
+})
+
+LockKey = Tuple[str, str]  # (owner, attr/name)
+
+
+def fmt_key(key: LockKey) -> str:
+    """Human name for a lock key: ``MicroBatcher._cond`` for class
+    attrs, ``faults._lock`` for module/function locals."""
+    owner, attr = key
+    return "%s.%s" % (owner.rsplit(".", 1)[-1].rsplit(":", 1)[-1], attr)
+
+
+class _FnLocks:
+    """One function's lock summary (see module docstring)."""
+
+    __slots__ = (
+        "path", "key", "node",
+        "acquisitions",   # [(lock key, ast node, held-before tuple)]
+        "calls",          # [((callee path, callee key), node, held tuple)]
+        "blocking",       # [(node, label, held tuple)]
+        "waits",          # [(key, node, held, in_while, is_wait_for)]
+        "notifies",       # [(key, node, held, method name)]
+        "leaks",          # [(node, message)]
+    )
+
+    def __init__(self, path: str, key: str, node: ast.AST):
+        self.path = path
+        self.key = key
+        self.node = node
+        self.acquisitions = []
+        self.calls = []
+        self.blocking = []
+        self.waits = []
+        self.notifies = []
+        self.leaks = []
+
+
+class _ModuleLockDecls:
+    """Where this module's locks live: ctor-evidence tables keyed the
+    same way the use-site resolver looks them up."""
+
+    def __init__(self, m: ModuleInfo):
+        self.m = m
+        self.class_attr: Dict[Tuple[str, str], str] = {}  # (cls, attr)->kind
+        self.module_vars: Dict[str, str] = {}
+        self.func_local: Dict[Tuple[str, str], str] = {}  # (fnkey, name)
+        self._scan()
+
+    def _scan(self) -> None:
+        m = self.m
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def enclosing(node):
+            p = parents.get(node)
+            while p is not None and not isinstance(
+                p, FuncNode + (ast.ClassDef,)
+            ):
+                p = parents.get(p)
+            return p
+
+        for node in ast.walk(m.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            kind = _CTOR_KINDS.get(qualname(node.value.func) or "")
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                tq = qualname(tgt)
+                if tq and tq.startswith("self.") and tq.count(".") == 1:
+                    # attribute of the enclosing class (walk up past the
+                    # defining method to its ClassDef)
+                    p = enclosing(node)
+                    while p is not None and not isinstance(p, ast.ClassDef):
+                        p = enclosing(p)
+                    if p is not None:
+                        self.class_attr[(p.name, tq.split(".", 1)[1])] = kind
+                elif isinstance(tgt, ast.Name):
+                    scope = enclosing(node)
+                    while scope is not None and not isinstance(
+                        scope, FuncNode
+                    ):
+                        scope = enclosing(scope)
+                    if scope is None:
+                        self.module_vars[tgt.id] = kind
+                    else:
+                        fk = m.key_of.get(id(scope))
+                        if fk is not None:
+                            self.func_local[(fk, tgt.id)] = kind
+
+    def resolve(
+        self, fkey: str, cls: Optional[str], q: str
+    ) -> Optional[Tuple[LockKey, str]]:
+        """The lock key + kind a dotted use-site name refers to, or None
+        when it is not a ctor-evidenced lock of this module."""
+        if q.startswith("self."):
+            attr = q.split(".", 1)[1]
+            if "." in attr or cls is None:
+                return None
+            kind = self.class_attr.get((cls, attr))
+            if kind is None:
+                return None
+            return ((self.m.name + "." + cls, attr), kind)
+        if "." in q:
+            return None  # obj.attr locks: type unknown, contribute nothing
+        scope = fkey
+        while scope:
+            kind = self.func_local.get((scope, q))
+            if kind is not None:
+                return ((self.m.name + ":" + scope, q), kind)
+            scope = (
+                scope.rpartition(".<locals>.")[0]
+                if ".<locals>." in scope
+                else ""
+            )
+        kind = self.module_vars.get(q)
+        if kind is not None:
+            return ((self.m.name, q), kind)
+        return None
+
+
+def _call_args(call: ast.Call):
+    return list(call.args) + [kw.value for kw in call.keywords]
+
+
+def _classify_blocking(call: ast.Call) -> Optional[str]:
+    """Label when ``call`` is an unbounded blocking operation; None
+    otherwise. Bounded variants (``join(timeout)``, ``wait(t)``,
+    ``get(..., timeout=...)``) are deliberately not flagged."""
+    q = qualname(call.func)
+    if q is not None:
+        label = _BLOCKING_SIMPLE.get(q)
+        if label is not None:
+            return label
+        head, _, last = q.rpartition(".")
+        if head.split(".")[-1] == "subprocess" and (
+            last in _BLOCKING_SUBPROCESS
+        ):
+            return "subprocess.%s (child-process wait)" % last
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in _BLOCKING_ATTRS and attr != "connect":
+        return "%s() (blocking I/O)" % attr
+    if attr == "connect" and not _call_args(call):
+        return None  # zero-arg connect is not the socket shape
+    has_args = bool(_call_args(call))
+    if attr == "join" and not has_args:
+        # str.join/os.path.join always take an argument, so a zero-arg
+        # .join() is a thread/process join — unbounded
+        return "join() without a timeout"
+    if attr == "get" and not has_args:
+        # dict.get/os.environ.get need a key: a zero-arg .get() is a
+        # queue.Queue.get() that blocks until a producer shows up
+        return "queue get() without a timeout"
+    return None
+
+
+class LockAnalysis:
+    """The whole-run lock pass. Built lazily by ``ProjectGraph.locks()``
+    the first time a concurrency rule asks; every product is memoized."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.decls: Dict[str, _ModuleLockDecls] = {}
+        self.fns: Dict[Tuple[str, str], _FnLocks] = {}
+        self._node_of: Dict[Tuple[str, str], ast.AST] = {}
+        self._by_path: Dict[str, List[_FnLocks]] = {}
+        self._cycles: Optional[List[dict]] = None
+        self._entry_held: Optional[Dict] = None
+        self._blocking_findings: Optional[Dict[str, list]] = None
+        graph._analyze()  # the call graph the propagation rides on
+        for m in list(graph.by_path.values()):
+            self._analyze_module(m)
+
+    # -- per-module extraction ----------------------------------------
+
+    def _analyze_module(self, m: ModuleInfo) -> None:
+        decls = _ModuleLockDecls(m)
+        self.decls[m.path] = decls
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for key, d in m.defs.items():
+            if not isinstance(d, FuncNode):
+                continue
+            fn = self._walk_fn(m, decls, parents, key, d)
+            self.fns[(m.path, key)] = fn
+            self._node_of[(m.path, key)] = d
+            self._by_path.setdefault(m.path, []).append(fn)
+
+    def _walk_fn(
+        self,
+        m: ModuleInfo,
+        decls: _ModuleLockDecls,
+        parents: Dict[ast.AST, ast.AST],
+        fkey: str,
+        d: ast.AST,
+    ) -> _FnLocks:
+        fn = _FnLocks(m.path, fkey, d)
+        cls = m.cls_of.get(id(d))
+        graph = self.graph
+        # frozensets of keys released by enclosing finally blocks: an
+        # early return/raise is covered when every explicitly-held lock
+        # appears in one of these
+        protected: List[frozenset] = []
+        # acquire nodes already flagged by an exit-path leak — the
+        # end-of-function sweep must not report the same acquire twice
+        leaked_origins: set = set()
+
+        def lock_of(expr: ast.AST) -> Optional[Tuple[LockKey, str]]:
+            q = qualname(expr)
+            if q is None:
+                return None
+            return decls.resolve(fkey, cls, q)
+
+        def held_keys(held) -> Tuple[LockKey, ...]:
+            return tuple(k for k, _origin in held)
+
+        def in_while(node: ast.AST) -> bool:
+            p = parents.get(node)
+            while p is not None and p is not d:
+                if isinstance(p, ast.While):
+                    return True
+                if isinstance(p, FuncNode):
+                    return False
+                p = parents.get(p)
+            return False
+
+        def visit_call(call: ast.Call, held) -> None:
+            hk = held_keys(held)
+            r = graph._resolve_callable(m, parents, call, call.func)
+            if r is not None:
+                fn.calls.append(((r[0].path, r[1]), call, hk))
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                recv = lock_of(f.value)
+                if f.attr in ("wait", "wait_for") and recv is not None:
+                    key, kind = recv
+                    if kind == "cond":
+                        fn.waits.append(
+                            (key, call, hk, in_while(call),
+                             f.attr == "wait_for")
+                        )
+                        return  # a condition wait is never re-classified
+                    if kind == "event":
+                        if not _call_args(call):
+                            fn.blocking.append(
+                                (call,
+                                 "Event.wait() without a timeout", hk)
+                            )
+                        return
+                if f.attr in ("notify", "notify_all") and recv is not None:
+                    key, kind = recv
+                    if kind == "cond":
+                        fn.notifies.append((key, call, hk, f.attr))
+                        return
+                if f.attr == "wait" and recv is None and not _call_args(
+                    call
+                ):
+                    fn.blocking.append(
+                        (call, "unbounded wait()", hk)
+                    )
+                    return
+            label = _classify_blocking(call)
+            if label is not None:
+                fn.blocking.append((call, label, hk))
+
+        def scan_exprs(node: ast.AST, held) -> None:
+            """In-order visit of every Call in ``node``'s subtree, not
+            descending into nested defs/lambdas (their bodies run later,
+            under whatever locks their own callers hold)."""
+            if isinstance(node, FuncNode + (ast.Lambda,)):
+                return
+            if isinstance(node, ast.Call):
+                visit_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                scan_exprs(child, held)
+
+        def acquire_release_in(stmt: ast.AST, held: list) -> list:
+            """Apply explicit ``acquire()``/``release()`` calls inside
+            one statement to the running held list."""
+            for node in walk_no_nested_funcs(stmt):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("acquire", "release")
+                ):
+                    continue
+                recv = lock_of(node.func.value)
+                if recv is None or recv[1] not in _LOCK_KINDS:
+                    continue
+                key = recv[0]
+                if node.func.attr == "acquire":
+                    fn.acquisitions.append((key, node, held_keys(held)))
+                    held = held + [(key, node)]
+                else:
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == key:
+                            held = held[:i] + held[i + 1:]
+                            break
+            return held
+
+        def exit_leaks(stmt: ast.AST, held) -> None:
+            cover = frozenset().union(*protected) if protected else (
+                frozenset()
+            )
+            for key, origin in held:
+                if not isinstance(origin, ast.Call):
+                    continue  # with-blocks release on every exit path
+                if key in cover:
+                    continue
+                kind = (
+                    "return" if isinstance(stmt, ast.Return) else "raise"
+                )
+                leaked_origins.add(id(origin))
+                fn.leaks.append(
+                    (stmt,
+                     "early %s while %s is still held (acquired at line "
+                     "%d with no covering try/finally release) — every "
+                     "later acquirer deadlocks; use `with` or release in "
+                     "a finally" % (kind, fmt_key(key), origin.lineno))
+                )
+
+        def finally_released(finalbody) -> frozenset:
+            out = set()
+            for stmt in finalbody:
+                for node in walk_no_nested_funcs(stmt):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "release"
+                    ):
+                        recv = lock_of(node.func.value)
+                        if recv is not None:
+                            out.add(recv[0])
+            return frozenset(out)
+
+        def do_block(stmts: Sequence[ast.stmt], held: list) -> list:
+            for stmt in stmts:
+                held = do_stmt(stmt, held)
+            return held
+
+        def do_stmt(stmt: ast.stmt, held: list) -> list:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                newly = []
+                for item in stmt.items:
+                    scan_exprs(item.context_expr, held)
+                    lk = lock_of(item.context_expr)
+                    if (
+                        lk is not None
+                        and lk[1] in _LOCK_KINDS
+                        and lk[0] not in held_keys(held)
+                    ):
+                        fn.acquisitions.append(
+                            (lk[0], item.context_expr, held_keys(held))
+                        )
+                        newly.append((lk[0], "with"))
+                do_block(stmt.body, held + newly)
+                return held
+            if isinstance(stmt, ast.If):
+                scan_exprs(stmt.test, held)
+                h1 = do_block(stmt.body, list(held))
+                h2 = do_block(stmt.orelse, list(held))
+                k2 = held_keys(h2)
+                return [e for e in h1 if e[0] in k2]
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                scan_exprs(
+                    stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor))
+                    else stmt.test,
+                    held,
+                )
+                do_block(list(stmt.body) + list(stmt.orelse), list(held))
+                return held  # loop-internal imbalance is caught per-exit
+            if isinstance(stmt, ast.Try):
+                fin = finally_released(stmt.finalbody)
+                protected.append(fin)
+                h = do_block(stmt.body, list(held))
+                for handler in stmt.handlers:
+                    do_block(handler.body, list(held))
+                h = do_block(stmt.orelse, h)
+                protected.pop()
+                # the finally runs on the fall-through path too
+                return do_block(stmt.finalbody, h)
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                if stmt_value := getattr(stmt, "value", None):
+                    scan_exprs(stmt_value, held)
+                if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                    scan_exprs(stmt.exc, held)
+                exit_leaks(stmt, held)
+                return held
+            if isinstance(stmt, FuncNode + (ast.ClassDef,)):
+                return held  # nested defs are their own analysis units
+            scan_exprs(stmt, held)
+            return acquire_release_in(stmt, held)
+
+        end_held = do_block(d.body, [])
+        for key, origin in end_held:
+            if isinstance(origin, ast.Call) and id(origin) not in (
+                leaked_origins
+            ):
+                fn.leaks.append(
+                    (origin,
+                     "%s is acquired here but no path through %r releases "
+                     "it — every later acquirer deadlocks; use `with` or "
+                     "pair it with release() in a finally"
+                     % (fmt_key(key), fkey.rsplit(".", 1)[-1]))
+                )
+        return fn
+
+    # -- whole-project propagation -------------------------------------
+
+    def _acquired_closure(self) -> Dict[Tuple[str, str], Set[LockKey]]:
+        """fn -> every lock it (or any transitive callee) acquires."""
+        if getattr(self, "_acq_closure", None) is not None:
+            return self._acq_closure
+        acq: Dict[Tuple[str, str], Set[LockKey]] = {}
+        for nk, fn in self.fns.items():
+            acq[nk] = {k for k, _n, _h in fn.acquisitions}
+        changed = True
+        while changed:
+            changed = False
+            for nk, fn in self.fns.items():
+                mine = acq[nk]
+                for callee, _node, _held in fn.calls:
+                    extra = acq.get(callee)
+                    if extra and not extra.issubset(mine):
+                        mine |= extra
+                        changed = True
+        self._acq_closure = acq
+        return acq
+
+    def entry_held(self) -> Dict[Tuple[str, str], Dict[LockKey, str]]:
+        """fn -> {lock key: provenance} for locks held by some caller at
+        a resolved call site (transitively). ``*_locked`` methods of a
+        class owning exactly one lock/condition are seeded with that
+        lock — the repo's caller-holds-the-lock convention."""
+        if self._entry_held is not None:
+            return self._entry_held
+        entry: Dict[Tuple[str, str], Dict[LockKey, str]] = {
+            nk: {} for nk in self.fns
+        }
+        # the *_locked convention seed
+        for (path, key), fn in self.fns.items():
+            base = key.rsplit(".", 1)[-1]
+            if not base.endswith("_locked"):
+                continue
+            cls = None
+            m = self.graph.by_path.get(path)
+            if m is not None:
+                cls = m.cls_of.get(id(fn.node))
+            if cls is None:
+                continue
+            decls = self.decls.get(path)
+            if decls is None:
+                continue
+            owned = [
+                ((m.name + "." + c, a), kind)
+                for (c, a), kind in decls.class_attr.items()
+                if c == cls and kind in _LOCK_KINDS
+            ]
+            if len(owned) == 1:
+                entry[(path, key)][owned[0][0]] = (
+                    "the %s caller-holds-the-lock convention" % base
+                )
+        changed = True
+        while changed:
+            changed = False
+            for nk, fn in self.fns.items():
+                caller_entry = entry[nk]
+                for callee, node, held in fn.calls:
+                    if callee not in entry:
+                        continue
+                    tgt = entry[callee]
+                    for k in held:
+                        if k not in tgt:
+                            tgt[k] = "%s (%s:%d)" % (
+                                fn.key.rsplit(".", 1)[-1],
+                                os.path.basename(fn.path),
+                                node.lineno,
+                            )
+                            changed = True
+                    for k, why in caller_entry.items():
+                        if k not in tgt:
+                            tgt[k] = why
+                            changed = True
+        self._entry_held = entry
+        return entry
+
+    # -- rule products --------------------------------------------------
+
+    def order_edges(self) -> Dict[Tuple[LockKey, LockKey], Tuple[str, int, str]]:
+        """(held, acquired) -> one witness site (path, line, fn name).
+        Local nesting and interprocedural acquisition both contribute;
+        the witness is the smallest (path, line) for determinism."""
+        if getattr(self, "_edges", None) is not None:
+            return self._edges
+        acq = self._acquired_closure()
+        entry = self.entry_held()
+        edges: Dict[Tuple[LockKey, LockKey], Tuple[str, int, str]] = {}
+
+        def add(a: LockKey, b: LockKey, path: str, line: int, fname: str):
+            if a == b:
+                return  # reentrancy is the cond/RLock idiom, not an order
+            site = (path, line, fname)
+            cur = edges.get((a, b))
+            if cur is None or site[:2] < cur[:2]:
+                edges[(a, b)] = site
+        for nk, fn in self.fns.items():
+            fname = fn.key.rsplit(".", 1)[-1]
+            ent = tuple(entry.get(nk, ()))
+            for key, node, held in fn.acquisitions:
+                for h in tuple(held) + ent:
+                    add(h, key, fn.path, node.lineno, fname)
+            for callee, node, held in fn.calls:
+                inner = acq.get(callee)
+                if not inner:
+                    continue
+                for h in tuple(held) + ent:
+                    for key in inner:
+                        add(h, key, fn.path, node.lineno, fname)
+        self._edges = edges
+        return edges
+
+    def cycles(self) -> List[dict]:
+        """Elementary lock-order cycles, each reported once: a sorted
+        list of {keys, edges, witness} dicts. Tarjan SCCs first, then
+        one deterministic cycle per SCC."""
+        if self._cycles is not None:
+            return self._cycles
+        edges = self.order_edges()
+        succ: Dict[LockKey, List[LockKey]] = {}
+        for (a, b) in edges:
+            succ.setdefault(a, []).append(b)
+            succ.setdefault(b, [])
+        for k in succ:
+            succ[k].sort()
+        index: Dict[LockKey, int] = {}
+        low: Dict[LockKey, int] = {}
+        on: Set[LockKey] = set()
+        stack: List[LockKey] = []
+        sccs: List[List[LockKey]] = []
+        counter = [0]
+
+        def strongconnect(v: LockKey) -> None:
+            # iterative Tarjan (fixture graphs are tiny, but recursion
+            # depth must not depend on linted input)
+            work = [(v, iter(succ[v]))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(succ[w])))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+
+        for v in sorted(succ):
+            if v not in index:
+                strongconnect(v)
+
+        out = []
+        for comp in sccs:
+            comp_set = set(comp)
+            # one deterministic elementary cycle per SCC: BFS from each
+            # successor of the smallest key back to it, shortest wins
+            start = comp[0]
+            path = None
+            for w in succ[start]:
+                if w not in comp_set:
+                    continue
+                prev: Dict[LockKey, Optional[LockKey]] = {w: None}
+                frontier = [w]
+                while frontier and start not in prev:
+                    nxt_frontier = []
+                    for n in frontier:
+                        for v in succ[n]:
+                            if v in comp_set and v not in prev:
+                                prev[v] = n
+                                nxt_frontier.append(v)
+                    frontier = nxt_frontier
+                if start not in prev:
+                    continue
+                nodes = [start]
+                n = start
+                while prev[n] is not None:
+                    n = prev[n]
+                    nodes.append(n)
+                cand = [start] + list(reversed(nodes[1:]))
+                if path is None or len(cand) < len(path):
+                    path = cand
+            if path is None:
+                continue
+            cyc_edges = []
+            for i, a in enumerate(path):
+                b = path[(i + 1) % len(path)]
+                site = edges.get((a, b))
+                if site is not None:
+                    cyc_edges.append((a, b, site))
+            if len(cyc_edges) < 2:
+                continue
+            witness = min(cyc_edges, key=lambda e: e[2][:2])
+            out.append({
+                "keys": path,
+                "edges": cyc_edges,
+                "witness": witness,
+            })
+        out.sort(key=lambda c: c["witness"][2][:2])
+        self._cycles = out
+        return out
+
+    def cycle_findings_for(self, path: str) -> List[Tuple[int, int, str]]:
+        """(line, col, message) per cycle whose witness edge sits in the
+        module at ``path`` — each cycle is reported exactly once, at its
+        deterministic witness site."""
+        ap = os.path.abspath(path)
+        out = []
+        for cyc in self.cycles():
+            a, b, (wpath, wline, wfn) = cyc["witness"]
+            if os.path.abspath(wpath) != ap:
+                continue
+            others = [
+                "%s -> %s at %s:%d (in %s)" % (
+                    fmt_key(x), fmt_key(y),
+                    os.path.basename(sp), sl, sf,
+                )
+                for x, y, (sp, sl, sf) in cyc["edges"]
+                if (x, y) != (a, b)
+            ]
+            msg = (
+                "lock-order inversion: %s is acquired while %s is held "
+                "(here, in %s), but the opposite order exists — %s — so "
+                "two threads interleaving these paths deadlock; pick ONE "
+                "global order for %s"
+                % (
+                    fmt_key(b), fmt_key(a), wfn,
+                    "; ".join(others),
+                    " and ".join(sorted({fmt_key(k) for k in cyc["keys"]})),
+                )
+            )
+            out.append((wline, 0, msg))
+        return out
+
+    def blocking_findings_for(self, path: str) -> List[Tuple[int, int, str]]:
+        """(line, col, message) for every blocking call in ``path`` made
+        while a lock is held — locally, or via the held-sets its callers
+        propagate through the call graph."""
+        if self._blocking_findings is None:
+            entry = self.entry_held()
+            by_path: Dict[str, list] = {}
+            for nk, fn in self.fns.items():
+                ent = entry.get(nk, {})
+                for node, label, held in fn.blocking:
+                    if held:
+                        lock = held[-1]
+                        why = "held here in %s" % fn.key.rsplit(".", 1)[-1]
+                    elif ent:
+                        lock = sorted(ent)[0]
+                        why = "held by a caller: %s" % ent[lock]
+                    else:
+                        continue
+                    msg = (
+                        "%s while %s is %s — the stall freezes every "
+                        "thread contending for that lock (frontend "
+                        "handlers, the canary poll, the batcher worker); "
+                        "move the blocking call outside the critical "
+                        "section or bound it with a timeout"
+                        % (label, fmt_key(lock), why)
+                    )
+                    by_path.setdefault(fn.path, []).append(
+                        (node.lineno, node.col_offset, msg)
+                    )
+            self._blocking_findings = by_path
+        return sorted(self._blocking_findings.get(os.path.abspath(path), []))
+
+    def cond_findings_for(self, path: str) -> List[Tuple[int, int, str]]:
+        """Condition-discipline findings for ``path``: wait() without
+        the condition held, wait() outside a while-predicate loop, and
+        notify()/notify_all() without the condition held."""
+        ap = os.path.abspath(path)
+        out = []
+        entry = self.entry_held()
+        for nk, fn in self.fns.items():
+            if os.path.abspath(fn.path) != ap:
+                continue
+            ent = entry.get(nk, {})
+            for key, node, held, in_loop, is_wait_for in fn.waits:
+                if key not in held and key not in ent:
+                    out.append((
+                        node.lineno, node.col_offset,
+                        "%s.wait() without holding %s — raises "
+                        "RuntimeError('cannot wait on un-acquired lock') "
+                        "at runtime; wrap it in `with %s:`"
+                        % (fmt_key(key), fmt_key(key), fmt_key(key)),
+                    ))
+                    continue
+                if not is_wait_for and not in_loop:
+                    out.append((
+                        node.lineno, node.col_offset,
+                        "%s.wait() outside a while-predicate loop — "
+                        "spurious wakeups and missed notifies are both "
+                        "legal, so the predicate must be re-checked: "
+                        "`while not <pred>: cond.wait()` (or use "
+                        "wait_for)" % fmt_key(key),
+                    ))
+            for key, node, held, meth in fn.notifies:
+                if key not in held and key not in ent:
+                    out.append((
+                        node.lineno, node.col_offset,
+                        "%s.%s() without holding %s — raises "
+                        "RuntimeError at runtime, and a notify racing "
+                        "the waiter's predicate check is a lost wakeup; "
+                        "hold the condition to notify"
+                        % (fmt_key(key), meth, fmt_key(key)),
+                    ))
+        return sorted(out)
+
+    def leak_findings_for(self, path: str) -> List[Tuple[int, int, str]]:
+        ap = os.path.abspath(path)
+        out = []
+        for fn in self._by_path.get(ap, ()):  # insertion order is stable
+            for node, msg in fn.leaks:
+                out.append((node.lineno, node.col_offset, msg))
+        return sorted(out)

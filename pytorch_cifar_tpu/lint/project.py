@@ -730,6 +730,18 @@ class ProjectGraph:
             if nk[0] == ap and nk in self._node_of
         }
 
+    # -- lock-effect analysis (lint/locks.py) ---------------------------
+
+    def locks(self):
+        """The whole-run lock-effect pass (held-set propagation, the
+        lock-order graph, blocking/cond/leak findings) — built lazily on
+        first use by a concurrency rule, memoized for the run."""
+        if getattr(self, "_locks", None) is None:
+            from pytorch_cifar_tpu.lint.locks import LockAnalysis
+
+            self._locks = LockAnalysis(self)
+        return self._locks
+
     # -- import graph (CLI: --graph, graph-aware --changed) -------------
 
     def _import_edges(self) -> Dict[str, Set[str]]:

@@ -1,4 +1,4 @@
-"""graftcheck rules: 11 JAX/concurrency invariants this repo has bled for.
+"""graftcheck rules: 16 JAX/concurrency invariants this repo has bled for.
 
 Every rule is grounded in a failure mode from this repo's own history
 (STATIC_ANALYSIS.md has the catalog with one real-world example each).
@@ -1531,16 +1531,21 @@ class AtomicPublish(Rule):
         return out
 
     def _check_rename(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        """Statement-position-aware write→fsync→rename ordering: each
+        rename of an in-function-written source needs an fsync that runs
+        AFTER the write and BEFORE the rename. Mere fsync presence is
+        not enough — `write; rename; fsync` journals the rename first
+        and publishes a complete-looking torn file after a crash (the
+        flow-insensitivity known-limit PR 8 documented, closed here)."""
         written = self._written_paths(fn)
         if not written:
             return []
-        has_fsync = any(
-            isinstance(n, ast.Call)
-            and (qualname(n.func) or "").rsplit(".", 1)[-1] == "fsync"
+        fsync_lines = sorted(
+            n.lineno
             for n in walk_no_nested_funcs(fn)
+            if isinstance(n, ast.Call)
+            and (qualname(n.func) or "").rsplit(".", 1)[-1] == "fsync"
         )
-        if has_fsync:
-            return []
         out = []
         for node in walk_no_nested_funcs(fn):
             if not isinstance(node, ast.Call):
@@ -1548,16 +1553,29 @@ class AtomicPublish(Rule):
             if qualname(node.func) not in _RENAME_FNS or not node.args:
                 continue
             src = self._write_key(node.args[0])
-            if src in written:
+            wnode = written.get(src)
+            if wnode is None:
+                continue
+            ordered = any(
+                wnode.lineno <= fl <= node.lineno for fl in fsync_lines
+            )
+            if not ordered:
+                why = (
+                    "was written with no fsync"
+                    if not fsync_lines
+                    else "has no fsync BETWEEN the write (line %d) and "
+                    "this rename — an fsync after the rename is too "
+                    "late, the rename is already journaled"
+                    % wnode.lineno
+                )
                 out.append(
                     self.finding(
                         ctx, node,
-                        "%r is renamed into place but was written with "
-                        "no fsync — the rename can hit the journal "
-                        "before the data blocks do, publishing a "
-                        "complete-looking empty/torn file after a "
-                        "crash; use the tmp+fsync+rename shape "
-                        "(train/checkpoint._atomic_write)" % src,
+                        "%r is renamed into place but %s — the rename "
+                        "can hit the journal before the data blocks do, "
+                        "publishing a complete-looking empty/torn file "
+                        "after a crash; use the tmp+fsync+rename shape "
+                        "(train/checkpoint._atomic_write)" % (src, why),
                     )
                 )
         return out
@@ -1793,6 +1811,180 @@ class ThreadJoin(Rule):
         return out
 
 
+# ---------------------------------------------------------------------
+# 12-15. concurrency-protocol rules (lint/locks.py: the lock-effect
+# analysis + whole-project held-set propagation they all ride on)
+# ---------------------------------------------------------------------
+
+
+class _LockRule(Rule):
+    """Shared shape: ask the memoized lock analysis for this module's
+    findings — the expensive pass runs once per lint run, not per rule
+    per file."""
+
+    provider = ""  # LockAnalysis method name
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        analysis = ctx.project.lock_analysis()
+        return [
+            Finding(self.name, ctx.relpath, line, col, msg)
+            for line, col, msg in getattr(analysis, self.provider)(ctx.path)
+        ]
+
+
+class LockOrderInversion(_LockRule):
+    name = "lock-order-inversion"
+    provider = "cycle_findings_for"
+    summary = (
+        "a cycle in the whole-project lock-order graph: two call paths "
+        "acquire the same locks in opposite order (nested `with`, or an "
+        "acquisition hiding behind cross-module calls) — one bad "
+        "interleaving deadlocks both threads; reported once, at the "
+        "cycle's smallest acquisition site"
+    )
+
+
+class BlockingUnderLock(_LockRule):
+    name = "blocking-under-lock"
+    provider = "blocking_findings_for"
+    summary = (
+        "an unbounded blocking call — join()/queue.get() without a "
+        "timeout, socket/HTTP I/O, subprocess, jax.device_get/"
+        "block_until_ready — while a lock is held (locally, or via the "
+        "held-set callers propagate through the call graph): the stall "
+        "freezes every thread contending for that lock"
+    )
+
+
+class CondWaitDiscipline(_LockRule):
+    name = "cond-wait-discipline"
+    provider = "cond_findings_for"
+    summary = (
+        "Condition.wait() outside a while-predicate loop (spurious "
+        "wakeups and missed notifies are legal — re-check or use "
+        "wait_for), or wait()/notify()/notify_all() without the "
+        "condition held (RuntimeError at runtime; an unheld notify is "
+        "a lost wakeup)"
+    )
+
+
+class LockLeak(_LockRule):
+    name = "lock-leak"
+    provider = "leak_findings_for"
+    summary = (
+        "acquire()/release() imbalance on some path: a lock acquired "
+        "but never released, or an early return/raise that skips the "
+        "release with no covering try/finally — every later acquirer "
+        "deadlocks; prefer `with`, or release in a finally"
+    )
+
+
+# ---------------------------------------------------------------------
+# 16. metric-name-drift
+# ---------------------------------------------------------------------
+
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+_METRIC_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+
+def parse_metric_doc_names(md_text: str) -> Set[str]:
+    """Metric names documented in OBSERVABILITY.md's tables: the
+    backticked tokens of each table row's FIRST cell. A token starting
+    with '.' continues the previous full name's prefix (the
+    ``serve.reload.reloads`` / ``.skipped`` doc idiom); tokens that are
+    not dotted lowercase identifiers (paths, ``<code>`` templates,
+    flags) are ignored."""
+    names: Set[str] = set()
+    for line in md_text.splitlines():
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        first = cells[1]
+        prev: Optional[str] = None
+        for tok in re.findall(r"`([^`]+)`", first):
+            tok = tok.strip()
+            if tok.startswith(".") and prev is not None:
+                tok = prev.rsplit(".", 1)[0] + tok
+            if _METRIC_NAME_RE.match(tok):
+                names.add(tok)
+                prev = tok
+    return names
+
+
+def metric_literals(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """Every ``<registry>.counter/gauge/histogram("literal")`` call in
+    ``tree``. Dynamic names (f-strings like ``serve.http_{code}``) are
+    skipped — only literals can be doc-checked."""
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_FACTORIES
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.append((node.args[0].value, node))
+    return out
+
+
+def metric_dynamic_prefixes(tree: ast.AST) -> List[str]:
+    """Literal PREFIXES of dynamically named metrics — the
+    ``counter(f"serve.reload.{event}")`` idiom. The `--docs` doc→code
+    check treats a documented name covered by such a prefix as created
+    (it cannot verify the suffix statically; that stays a known
+    limit)."""
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_FACTORIES
+            and node.args
+            and isinstance(node.args[0], ast.JoinedStr)
+            and node.args[0].values
+        ):
+            first = node.args[0].values[0]
+            if (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value
+            ):
+                out.append(first.value)
+    return out
+
+
+class MetricNameDrift(Rule):
+    name = "metric-name-drift"
+    summary = (
+        "a registry.counter/gauge/histogram(\"name\") literal that "
+        "appears in no OBSERVABILITY.md metric table — the obs docs rot "
+        "silently otherwise; `tools/lint.py --docs` warns in the other "
+        "direction (documented names no code creates)"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        doc = ctx.project.metric_doc_names()
+        if doc is None:
+            return []  # no OBSERVABILITY.md at the repo root: fixtures
+        out = []
+        for name, node in metric_literals(ctx.tree):
+            if name not in doc:
+                out.append(
+                    self.finding(
+                        ctx, node,
+                        "metric %r is created here but documented in no "
+                        "OBSERVABILITY.md table — add a row (name | "
+                        "kind | meaning) or rename to a documented "
+                        "metric" % name,
+                    )
+                )
+        return out
+
+
 RULES = (
     JitImpurity(),
     PrngReuse(),
@@ -1805,6 +1997,11 @@ RULES = (
     ThreadCollective(),
     AtomicPublish(),
     ThreadJoin(),
+    LockOrderInversion(),
+    BlockingUnderLock(),
+    CondWaitDiscipline(),
+    LockLeak(),
+    MetricNameDrift(),
 )
 
 

@@ -38,6 +38,7 @@ def _build() -> bool:
         _SRC, "-o", tmp,
     ]
     try:
+        # graftcheck: noqa[blocking-under-lock] -- one-time lazy build: _lib_lock SHOULD serialize concurrent loaders behind the single g++ compile (racing builders is the bug), and timeout=120 bounds the stall
         subprocess.run(
             cmd, check=True, capture_output=True, timeout=120
         )

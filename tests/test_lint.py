@@ -54,13 +54,19 @@ def run_rule(tmp_path, src: str, rule: str, name="snippet.py"):
 # ---------------------------------------------------------------------
 
 
-def test_rule_registry_has_at_least_eleven_rules():
-    assert len(RULES) >= 11
+def test_rule_registry_has_at_least_sixteen_rules():
+    assert len(RULES) >= 16
     assert len(set(rule_names())) == len(RULES)
     for r in RULES:
         assert r.summary, r.name
     # the PR 8 additions are registered
     for name in ("thread-collective", "atomic-publish", "thread-join"):
+        assert name in rule_names()
+    # the concurrency-protocol rules (lint/locks.py) + the obs-docs gate
+    for name in (
+        "lock-order-inversion", "blocking-under-lock",
+        "cond-wait-discipline", "lock-leak", "metric-name-drift",
+    ):
         assert name in rule_names()
 
 
@@ -997,8 +1003,449 @@ def test_flag_config_drift_checks_real_config_surface():
 
 
 # ---------------------------------------------------------------------
+# concurrency-protocol rules (lint/locks.py)
+# ---------------------------------------------------------------------
+
+
+def test_lock_order_inversion_cross_module(tmp_path):
+    """THE deadlock shape from the issue: two modules acquire the same
+    two locks in opposite order, each opposite-side acquisition hiding
+    behind a cross-module call. Reported exactly ONCE, at the cycle's
+    deterministic witness site."""
+    d = tmp_path / "dl"
+    d.mkdir()
+    (d / "a.py").write_text(textwrap.dedent("""
+    import threading
+    from b import poke_b
+
+    LA = threading.Lock()
+
+    def use_a_then_b():
+        with LA:
+            poke_b()
+
+    def touch_a():
+        with LA:
+            pass
+    """))
+    (d / "b.py").write_text(textwrap.dedent("""
+    import threading
+    from a import touch_a
+
+    LB = threading.Lock()
+
+    def poke_b():
+        with LB:
+            pass
+
+    def use_b_then_a():
+        with LB:
+            touch_a()
+    """))
+    run = lint_paths([str(d)], rules=rules_by_name(["lock-order-inversion"]))
+    found = [f for f in run.findings if f.rule == "lock-order-inversion"]
+    assert len(found) == 1  # one cycle, one finding — not one per module
+    msg = found[0].message
+    assert "LA" in msg and "LB" in msg and "opposite order" in msg
+
+
+def test_lock_order_inversion_negative(tmp_path):
+    # consistent global order (both paths take LA before LB), plus the
+    # reentrant condition idiom — no cycle, no finding
+    d = tmp_path / "ok"
+    d.mkdir()
+    (d / "a.py").write_text(textwrap.dedent("""
+    import threading
+    from b import poke_b
+
+    LA = threading.Lock()
+
+    def use_a_then_b():
+        with LA:
+            poke_b()
+    """))
+    (d / "b.py").write_text(textwrap.dedent("""
+    import threading
+
+    LB = threading.Lock()
+
+    def poke_b():
+        with LB:
+            pass
+
+    class Reentrant:
+        def __init__(self):
+            self._cond = threading.Condition()
+
+        def outer(self):
+            with self._cond:
+                self.inner()
+
+        def inner(self):
+            with self._cond:
+                pass
+    """))
+    run = lint_paths([str(d)], rules=rules_by_name(["lock-order-inversion"]))
+    assert [f for f in run.findings if f.rule == "lock-order-inversion"] == []
+
+
+def test_blocking_under_lock_join_positive(tmp_path):
+    # the join-under-lock stall shape every PR 6-10 thread owner dodged
+    # by hand (take the handle under the lock, join OUTSIDE it)
+    src = """
+    import threading
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._thread = threading.Thread(target=self._run)
+
+        def _run(self):
+            pass
+
+        def stop(self):
+            with self._lock:
+                self._thread.join()
+    """
+    found = run_rule(tmp_path, src, "blocking-under-lock")
+    assert len(found) == 1
+    assert "join()" in found[0].message and "_lock" in found[0].message
+
+
+def test_blocking_under_lock_cross_module_positive(tmp_path):
+    """Held-set propagation through the call graph: the blocking call
+    lives in ANOTHER module that never mentions a lock — the caller's
+    held-set reaches it, and the finding names the caller."""
+    d = tmp_path / "xb"
+    d.mkdir()
+    (d / "util.py").write_text(textwrap.dedent("""
+    def drain(q):
+        return q.get()
+    """))
+    (d / "owner.py").write_text(textwrap.dedent("""
+    import threading
+    from util import drain
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def take(self, q):
+            with self._lock:
+                return drain(q)
+    """))
+    run = lint_paths([str(d)], rules=rules_by_name(["blocking-under-lock"]))
+    found = [f for f in run.findings if f.rule == "blocking-under-lock"]
+    assert len(found) == 1
+    assert found[0].path.endswith("util.py")
+    assert "queue get()" in found[0].message
+    assert "held by a caller: take" in found[0].message
+
+
+def test_blocking_under_lock_negative(tmp_path):
+    # the repo's own sanctioned shapes: handle taken under the lock but
+    # joined outside it, a BOUNDED join under the lock, bounded waits,
+    # and blocking calls with no lock held at all
+    src = """
+    import threading
+    import subprocess
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._run)
+
+        def _run(self):
+            while not self._stop.wait(0.1):
+                pass
+
+        def stop(self):
+            with self._lock:
+                t = self._thread
+                self._thread = None
+            if t is not None:
+                t.join()
+
+        def stop_bounded(self):
+            with self._lock:
+                self._thread.join(5.0)
+
+    def unlocked(q, cmd):
+        subprocess.run(cmd, check=True)
+        return q.get()
+    """
+    assert run_rule(tmp_path, src, "blocking-under-lock") == []
+
+
+def test_cond_wait_discipline_positive(tmp_path):
+    src = """
+    import threading
+
+    class Waiter:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.ready = False
+
+        def bad_wait(self):
+            with self._cond:
+                if not self.ready:
+                    self._cond.wait()
+
+        def bad_notify(self):
+            self._cond.notify_all()
+
+        def bad_unheld_wait(self):
+            self._cond.wait()
+    """
+    found = run_rule(tmp_path, src, "cond-wait-discipline")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 3
+    assert "while-predicate" in msgs
+    assert "notify_all() without holding" in msgs
+    assert "wait() without holding" in msgs
+
+
+def test_cond_wait_discipline_negative(tmp_path):
+    # the batcher/writer shapes: wait in a while-predicate loop (timed
+    # variant included), wait_for, notify under the condition, and the
+    # *_locked caller-holds-the-lock convention
+    src = """
+    import threading
+
+    class Waiter:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._q = []
+            self._closed = False
+
+        def take(self):
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait()
+                return self._q.pop() if self._q else None
+
+        def take_timed(self, deadline):
+            with self._cond:
+                while not self._q:
+                    self._cond.wait(0.05)
+                return self._q.pop()
+
+        def take_pred(self):
+            with self._cond:
+                self._cond.wait_for(lambda: bool(self._q))
+                return self._q.pop()
+
+        def put(self, item):
+            with self._cond:
+                self._q.append(item)
+                self._cond.notify()
+
+        def _wake_all_locked(self):
+            self._cond.notify_all()
+
+        def close(self):
+            with self._cond:
+                self._closed = True
+                self._wake_all_locked()
+    """
+    assert run_rule(tmp_path, src, "cond-wait-discipline") == []
+
+
+def test_lock_leak_positive(tmp_path):
+    # the raise-path leak from the issue checklist + the never-released
+    # fall-through — both explicit acquire/release bugs `with` precludes
+    src = """
+    import threading
+
+    _lock = threading.Lock()
+
+    def leak_on_raise(x):
+        _lock.acquire()
+        if x:
+            raise ValueError("boom")
+        _lock.release()
+
+    def never_released():
+        _lock.acquire()
+        return 1
+    """
+    found = run_rule(tmp_path, src, "lock-leak")
+    assert len(found) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "early raise" in msgs
+    assert "early return" in msgs or "no path" in msgs
+
+
+def test_lock_leak_negative(tmp_path):
+    # with-blocks (release on every exit incl. raise), try/finally
+    # around an early return, and balanced acquire/release: all quiet
+    src = """
+    import threading
+
+    _lock = threading.Lock()
+
+    def with_block(x):
+        with _lock:
+            if x:
+                raise ValueError("boom")
+        return 1
+
+    def finally_covered(x):
+        _lock.acquire()
+        try:
+            if x:
+                return 1
+            return 2
+        finally:
+            _lock.release()
+
+    def balanced():
+        _lock.acquire()
+        _lock.release()
+    """
+    assert run_rule(tmp_path, src, "lock-leak") == []
+
+
+def test_atomic_publish_ordering_aware(tmp_path):
+    """The PR 8 known-limit closed: fsync PRESENCE is no longer enough —
+    an fsync AFTER the rename is too late (the rename is already
+    journaled), so `write; rename; fsync` now fires where the old
+    per-function presence check stayed quiet."""
+    src = """
+    import json
+    import os
+
+    def late_fsync(path, data):
+        tmp = path + ".tmp"
+        f = open(tmp, "w")
+        json.dump(data, f)
+        os.replace(tmp, path)
+        os.fsync(f.fileno())
+    """
+    found = run_rule(tmp_path, src, "atomic-publish")
+    assert len(found) == 1
+    assert "no fsync BETWEEN" in found[0].message
+
+    # write -> fsync -> rename -> dir-fsync (checkpoint._atomic_write's
+    # exact shape: the trailing directory fsync must not confuse the
+    # ordering check) stays quiet
+    src2 = """
+    import os
+
+    def atomic_write(path, data):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dfd = os.open(os.path.dirname(path), os.O_RDONLY)
+        os.fsync(dfd)
+        os.close(dfd)
+    """
+    assert run_rule(tmp_path, src2, "atomic-publish", "b.py") == []
+
+
+def test_metric_name_drift_fixture(tmp_path):
+    """An undocumented registry.counter(\"name\") literal fires; the
+    documented one (including the `.suffix` prefix-continuation doc
+    idiom) stays quiet. The doc is located at the repo root — the
+    fixture fakes one with the config.py marker."""
+    (tmp_path / "pytorch_cifar_tpu").mkdir()
+    (tmp_path / "pytorch_cifar_tpu" / "config.py").write_text("")
+    (tmp_path / "OBSERVABILITY.md").write_text(textwrap.dedent("""
+    | name | kind | meaning |
+    |---|---|---|
+    | `serve.requests` / `.images` | counter | admitted work |
+    | `serve.http_<code>` | counter | template row (skipped) |
+    """))
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+    def wire(registry):
+        a = registry.counter("serve.requests")
+        b = registry.counter("serve.images")
+        c = registry.histogram("serve.phantom_ms")
+        d = registry.counter(f"serve.http_{404}")
+        return a, b, c, d
+    """))
+    run = lint_paths(
+        [str(mod)],
+        rules=rules_by_name(["metric-name-drift"]),
+        repo_root=str(tmp_path),
+    )
+    found = [f for f in run.findings if f.rule == "metric-name-drift"]
+    assert len(found) == 1
+    assert "serve.phantom_ms" in found[0].message
+
+
+def test_metric_name_drift_silent_without_doc(tmp_path):
+    # fixture trees with no OBSERVABILITY.md at the root: rule inert
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "def wire(registry):\n"
+        "    return registry.counter(\"whatever.name\")\n"
+    )
+    run = lint_paths([str(mod)], rules=rules_by_name(["metric-name-drift"]))
+    assert [f for f in run.findings if f.rule == "metric-name-drift"] == []
+
+
+def test_metric_doc_names_parser():
+    """The real OBSERVABILITY.md parses into the names the tree creates:
+    spot-check the continuation idiom and the template skip."""
+    from pytorch_cifar_tpu.lint.rules import parse_metric_doc_names
+
+    with open(os.path.join(REPO, "OBSERVABILITY.md")) as f:
+        names = parse_metric_doc_names(f.read())
+    assert "serve.requests" in names
+    assert "serve.reload.skipped" in names  # `.skipped` continuation
+    assert "serve.aot_cache_misses" in names
+    assert not any("<" in n for n in names)  # serve.http_<code> skipped
+    assert "obs/metrics.py" not in names  # non-metric tables ignored
+
+
+# ---------------------------------------------------------------------
 # the tier-1 self-run: the tree must lint clean, fast
 # ---------------------------------------------------------------------
+
+
+def test_observability_doc_matches_created_metrics():
+    """Both drift directions on the REAL tree, in tier-1: every metric
+    literal the package/tools create is documented (the code→doc
+    direction is also the metric-name-drift rule inside the self-run),
+    and every documented table name is created somewhere — literally or
+    under a dynamic f-string prefix like `serve.reload.{event}` (the
+    `--docs` CLI direction, enforced here so a renamed metric cannot
+    leave its stale row behind)."""
+    from pytorch_cifar_tpu.lint.rules import (
+        metric_dynamic_prefixes,
+        metric_literals,
+        parse_metric_doc_names,
+    )
+
+    with open(os.path.join(REPO, "OBSERVABILITY.md")) as f:
+        doc = parse_metric_doc_names(f.read())
+    assert doc, "OBSERVABILITY.md tables parsed to nothing"
+    run = lint_paths(
+        [PKG, os.path.join(REPO, "tools"), os.path.join(REPO, "serve.py"),
+         os.path.join(REPO, "bench.py"), os.path.join(REPO, "train.py")],
+        rules=rules_by_name(["metric-name-drift"]),
+        repo_root=REPO,
+    )
+    assert [f for f in run.findings if f.status == "open"] == []
+    created, prefixes = set(), []
+    for rel in run.files:
+        path = rel if os.path.isabs(rel) else os.path.join(REPO, rel)
+        _, tree = run.project.source_and_tree(path)
+        created.update(n for n, _ in metric_literals(tree))
+        prefixes.extend(metric_dynamic_prefixes(tree))
+    stale = sorted(
+        n for n in doc - created
+        if not any(n.startswith(p) for p in prefixes)
+    )
+    assert stale == [], (
+        "OBSERVABILITY.md documents metrics no code creates: %s" % stale
+    )
 
 
 def test_package_lints_clean_and_fast():

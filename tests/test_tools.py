@@ -548,6 +548,211 @@ def test_lint_cli_changed_relints_reverse_dependencies(tmp_path):
     assert "app.py" in r.stdout and "[prng-reuse]" in r.stdout
 
 
+def test_lint_cli_sarif_schema(tmp_path):
+    """`--sarif` renders findings as SARIF 2.1.0 so standard code-review
+    tooling (GitHub code scanning, SARIF viewers) shows them inline.
+    Contract: open findings are level `error`; suppressed ones ride
+    along as `note` with an inSource suppression carrying the reason;
+    the graftcheck content fingerprint doubles as the SARIF partial
+    fingerprint; exit codes match the text mode."""
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)\n"
+        "    return a, b\n"
+    )
+    lint = os.path.join(REPO, "tools", "lint.py")
+    r = _run_tool([lint, "--no-baseline", "--sarif", str(dirty)],
+                  expected_returncode=1)
+    doc = json.loads(r.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftcheck"
+    rule_ids = {ru["id"] for ru in run["tool"]["driver"]["rules"]}
+    assert "prng-reuse" in rule_ids and "lock-order-inversion" in rule_ids
+    (res,) = run["results"]
+    assert res["ruleId"] == "prng-reuse"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("dirty.py")
+    assert loc["region"]["startLine"] > 0
+    assert len(res["partialFingerprints"]["graftcheck/v1"]) == 16
+    # a reasoned noqa becomes a note with an inSource suppression (the
+    # reason is the justification) and the run exits clean
+    dirty.write_text(
+        "import jax\n\ndef f(key):\n"
+        "    a = jax.random.bernoulli(key)\n"
+        "    b = jax.random.bernoulli(key)  "
+        "# graftcheck: noqa[prng-reuse] -- fixture reuse on purpose\n"
+        "    return a, b\n"
+    )
+    r = _run_tool([lint, "--no-baseline", "--sarif", str(dirty)])
+    (res,) = json.loads(r.stdout)["runs"][0]["results"]
+    assert res["level"] == "note"
+    assert res["suppressions"][0]["kind"] == "inSource"
+    assert "fixture reuse" in res["suppressions"][0]["justification"]
+
+
+def test_lint_cli_docs_mode(tmp_path):
+    """`--docs` cross-checks OBSERVABILITY.md's metric tables against
+    the tree's registry.counter/gauge/histogram literals in the doc→code
+    direction (code→doc is the metric-name-drift RULE): a stale table
+    row warns, a dynamically-prefixed family (`serve.reload.{event}`)
+    does not, and a synced doc reports zero."""
+    import shutil
+
+    repo = tmp_path / "r"
+    (repo / "tools").mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, "tools", "lint.py"),
+                repo / "tools" / "lint.py")
+    pkg = repo / "pytorch_cifar_tpu"
+    shutil.copytree(
+        os.path.join(REPO, "pytorch_cifar_tpu", "lint"), pkg / "lint"
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text("")
+    (repo / "OBSERVABILITY.md").write_text(
+        "| name | kind | meaning |\n"
+        "|---|---|---|\n"
+        "| `serve.requests` | counter | admitted |\n"
+        "| `serve.stale_row` | counter | renamed away |\n"
+        "| `serve.reload.reloads` | counter | dynamic family |\n"
+    )
+    mod = repo / "mod.py"
+    mod.write_text(
+        "def wire(registry):\n"
+        "    a = registry.counter(\"serve.requests\")\n"
+        "    b = registry.counter(f\"serve.reload.{'reloads'}\")\n"
+        "    return a, b\n"
+    )
+    r = subprocess.run(
+        [sys.executable, str(repo / "tools" / "lint.py"),
+         "--no-baseline", "--docs", str(mod)],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "WARNING metric 'serve.stale_row'" in r.stdout
+    assert "serve.reload.reloads" not in r.stdout  # prefix-covered
+    assert "1 documented-but-uncreated" in r.stdout
+    # doc brought back in sync: zero warnings
+    (repo / "OBSERVABILITY.md").write_text(
+        "| name | kind | meaning |\n"
+        "|---|---|---|\n"
+        "| `serve.requests` | counter | admitted |\n"
+        "| `serve.reload.reloads` | counter | dynamic family |\n"
+    )
+    r = subprocess.run(
+        [sys.executable, str(repo / "tools" / "lint.py"),
+         "--no-baseline", "--docs", str(mod)],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "WARNING" not in r.stdout
+    assert "0 documented-but-uncreated" in r.stdout
+
+
+def test_precommit_hook_blocks_seeded_lock_order_finding(tmp_path):
+    """The issue's acceptance drill: a lock-order INVERSION seeded by
+    editing ONE module must block a real `git commit` through
+    `--changed`'s reverse-dependency re-lint — the cycle's witness lands
+    in the UNCHANGED committed module (a.py), which only gets re-linted
+    because the import graph says a change to b.py can break it."""
+    import shutil
+    import stat
+    import subprocess as sp
+    import textwrap
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = dict(os.environ)
+    env.update(
+        GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+        GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+        PYTHON=sys.executable,
+    )
+
+    def git(*args):
+        sp.run(["git", *args], cwd=repo, check=True, env=env,
+               capture_output=True)
+
+    git("init", "-q")
+    tools = repo / "tools"
+    (tools / "githooks").mkdir(parents=True)
+    for rel in (("tools", "lint.py"), ("tools", "githooks", "pre-commit")):
+        shutil.copy(os.path.join(REPO, *rel), tools / os.path.join(*rel[1:]))
+    hook = tools / "githooks" / "pre-commit"
+    hook.chmod(hook.stat().st_mode | stat.S_IXUSR)
+    pkg = repo / "pytorch_cifar_tpu"
+    shutil.copytree(
+        os.path.join(REPO, "pytorch_cifar_tpu", "lint"), pkg / "lint"
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text("")
+    git("config", "core.hooksPath", "tools/githooks")
+
+    # the committed fleet: a.py holds LA then calls into b; b.py is
+    # (for now) cycle-free. Both live in the default linted tree so the
+    # import graph covers them.
+    (pkg / "a.py").write_text(textwrap.dedent("""
+    import threading
+    from b import poke_b
+
+    LA = threading.Lock()
+
+    def use_a_then_b():
+        with LA:
+            poke_b()
+
+    def touch_a():
+        with LA:
+            pass
+    """))
+    clean_b = textwrap.dedent("""
+    import threading
+    from a import touch_a
+
+    LB = threading.Lock()
+
+    def poke_b():
+        with LB:
+            pass
+
+    def use_b_then_a():
+        touch_a()
+    """)
+    (pkg / "b.py").write_text(clean_b)
+    git("add", "-A")
+    git("commit", "-qm", "seed fleet")
+
+    # the bad edit: b now takes LB and THEN calls into a (which takes
+    # LA) — with a.py's committed LA->LB path this is the deadlock cycle
+    (pkg / "b.py").write_text(clean_b.replace(
+        "def use_b_then_a():\n    touch_a()",
+        "def use_b_then_a():\n    with LB:\n        touch_a()",
+    ))
+    git("add", "pytorch_cifar_tpu/b.py")
+    r = sp.run([str(hook)], cwd=repo, env=env, capture_output=True,
+               text=True, timeout=120)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "[lock-order-inversion]" in r.stdout
+    assert "reverse dependenc" in r.stdout  # a.py re-linted via the graph
+    assert "a.py" in r.stdout  # the witness is the UNCHANGED module
+    c = sp.run(["git", "commit", "-qm", "deadlock"], cwd=repo, env=env,
+               capture_output=True, text=True, timeout=120)
+    assert c.returncode != 0, (c.stdout, c.stderr)
+    # the fix (call into a OUTSIDE LB — a real edit, not a revert, so
+    # the commit has content) sails through
+    (pkg / "b.py").write_text(
+        clean_b + "\n# release LB before crossing into a: LA < LB\n"
+    )
+    git("add", "pytorch_cifar_tpu/b.py")
+    r = sp.run([str(hook)], cwd=repo, env=env, capture_output=True,
+               text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    git("commit", "-qm", "ordered")
+
+
 def test_precommit_hook_blocks_seeded_finding(tmp_path):
     """tools/githooks/pre-commit (the `git config core.hooksPath
     tools/githooks` install) runs `tools/lint.py --changed` and must exit

@@ -122,6 +122,51 @@ def with_reverse_dependencies(changed: list) -> list:
     return sorted({os.path.abspath(p) for p in changed} | set(extra))
 
 
+def docs_report(run) -> list:
+    """The `--docs` vice-versa check: the code→doc direction is the
+    metric-name-drift RULE (an undocumented literal is a finding); this
+    reports the doc→code direction — OBSERVABILITY.md table names that
+    no linted file creates — as warnings, so a renamed metric cannot
+    leave its stale row behind. Dynamic names (`serve.http_<code>`) are
+    template rows the parser already skips."""
+    doc_names = run.project.metric_doc_names() if run.project else None
+    if doc_names is None:
+        return ["graftcheck docs: no OBSERVABILITY.md at the repo root"]
+    from pytorch_cifar_tpu.lint.rules import (
+        metric_dynamic_prefixes,
+        metric_literals,
+    )
+
+    created = set()
+    prefixes: list = []
+    for rel in run.files:
+        path = rel if os.path.isabs(rel) else os.path.join(REPO, rel)
+        try:
+            _, tree = run.project.source_and_tree(path)
+        except (OSError, SyntaxError, ValueError):
+            continue
+        created.update(name for name, _node in metric_literals(tree))
+        prefixes.extend(metric_dynamic_prefixes(tree))
+    stale = sorted(
+        name
+        for name in doc_names - created
+        if not any(name.startswith(p) for p in prefixes)
+    )
+    out = [
+        "graftcheck docs: WARNING metric %r has an OBSERVABILITY.md "
+        "table row but no linted file creates it — stale after a "
+        "rename? (remove the row or restore the metric)" % name
+        for name in stale
+    ]
+    out.append(
+        "graftcheck docs: %d metric literal(s) in code, %d documented, "
+        "%d documented-but-uncreated" % (
+            len(created), len(doc_names), len(stale)
+        )
+    )
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="graftcheck: JAX-aware static analysis "
@@ -146,6 +191,14 @@ def main(argv=None) -> int:
                     "baseline file and exit 0")
     ap.add_argument("--verbose", action="store_true",
                     help="also print suppressed/baselined findings")
+    ap.add_argument("--sarif", action="store_true",
+                    help="SARIF 2.1.0 report on stdout (code-review "
+                    "tooling; exit codes unchanged)")
+    ap.add_argument("--docs", action="store_true",
+                    help="also cross-check OBSERVABILITY.md metric "
+                    "tables against the linted tree's "
+                    "registry.counter/gauge/histogram literals and "
+                    "warn about documented names no code creates")
     ap.add_argument("--graph", action="store_true",
                     help="dump the resolved import graph as JSON "
                     "(module -> imports) and exit")
@@ -241,7 +294,11 @@ def main(argv=None) -> int:
                 for name, s in sorted(run.stats.items())
             },
         }
-    if args.json:
+    if args.sarif:
+        import json
+
+        print(json.dumps(_engine.sarif_report(run.findings)))
+    elif args.json:
         import json
 
         rep = _engine.json_report(run.findings, stale)
@@ -255,6 +312,9 @@ def main(argv=None) -> int:
             import json
 
             print("graftcheck stats: %s" % json.dumps(stats))
+    if args.docs:
+        for line in docs_report(run):
+            print(line)
     open_count = sum(1 for f in run.findings if f.status == "open")
     return EXIT_FINDINGS if open_count else EXIT_CLEAN
 
