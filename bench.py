@@ -707,6 +707,56 @@ def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
     assert engine.compile_count == len(engine.buckets), (
         "serving bench recompiled after warmup"
     )
+    # int8 bucket-lane A/B (SERVING.md "int8 bucket lane"): the same
+    # model/seed/buckets quantized weight-only — throughput through the
+    # same closed loop, plus the argmax-agreement and relative-error
+    # numbers that, with the accuracy_run/zoo priors, decide whether the
+    # lane is worth serving for a given model. Honest caveat: random
+    # weights understate real-checkpoint disagreement; the canary gates
+    # are the production arbiter.
+    int8_engine = InferenceEngine.from_random(
+        model, buckets=buckets, compute_dtype=compute_dtype, mesh=mesh,
+        int8=True,
+    )
+    int8_batcher = MicroBatcher(
+        int8_engine, max_batch=max_b, max_wait_ms=2.0,
+        max_queue=8 * max_b,
+    )
+    try:
+        run_load(int8_batcher, clients=2, requests_per_client=2, seed=1)
+        int8_rep = run_load(
+            int8_batcher, clients=8, requests_per_client=max(steps, 2),
+            images_max=8, seed=0,
+        )
+    finally:
+        int8_batcher.close()
+    probe = np.random.RandomState(3).randint(
+        0, 256, size=(max_b, 32, 32, 3)
+    ).astype(np.uint8)
+    fp_logits = engine.predict(probe)
+    q_logits = int8_engine.predict(probe)
+    report["int8"] = {
+        "img_per_sec": round(int8_rep["img_per_sec"], 3),
+        "vs_fp": round(
+            int8_rep["img_per_sec"] / max(report["img_per_sec"], 1e-9), 4
+        ),
+        "argmax_agree": round(
+            float(
+                np.mean(
+                    np.argmax(fp_logits, -1) == np.argmax(q_logits, -1)
+                )
+            ),
+            4,
+        ),
+        "max_rel_err": round(
+            float(
+                np.max(np.abs(fp_logits - q_logits))
+                / max(float(np.max(np.abs(fp_logits))), 1e-9)
+            ),
+            5,
+        ),
+        "compiles": int(int8_engine.compile_count),
+    }
     report["max_batch"] = max_b
     report["n_devices"] = n_devices
     report["img_per_sec_per_chip"] = round(
@@ -733,13 +783,17 @@ def run_serve(model: str, batch: int, steps: int, compute_dtype) -> dict:
 
 
 def run_serve_http(model: str, batch: int, steps: int, compute_dtype) -> dict:
-    """The network-path A/B (SERVING.md "HTTP frontend & router"): the
-    SAME engine + micro-batcher serve the SAME closed-loop load twice —
-    once in-process (the ``--serve`` protocol) and once through the HTTP
-    frontend over loopback (JSON + base64 wire format, HTTP/1.1
-    keep-alive, one frontend handler thread per client). ``value`` is the
-    HTTP img/s; ``http_vs_inproc`` is the network-path tax, and the p50/
-    p95/p99 percentiles are the full-wire client-observed latencies."""
+    """The network-path A/Bs (SERVING.md "HTTP frontend & router" +
+    "Binary wire format"): the SAME engine + micro-batcher serve the
+    SAME closed-loop load in-process, over loopback HTTP with the JSON
+    (base64) encoding, and over the zero-copy binary wire frame.
+    ``value`` is the BINARY-wire img/s (the serve-roofline hot path);
+    ``wire_binary_vs_json`` is the encoding win, ``http_vs_inproc`` the
+    remaining network-path tax against the binary wire, and the p50/p95/
+    p99 percentiles are the binary wire's client-observed latencies (the
+    JSON ones ride along under ``wire_json_*``). A second in-process run
+    against a ``continuous=False`` batcher reports the continuous-
+    batching admission-to-completion A/B at the occupancy both ran."""
     from pytorch_cifar_tpu.obs import MetricsRegistry
     from pytorch_cifar_tpu.parallel import make_mesh
     from pytorch_cifar_tpu.serve import (
@@ -774,23 +828,53 @@ def run_serve_http(model: str, batch: int, steps: int, compute_dtype) -> dict:
     frontend = ServingFrontend(
         BatcherBackend(engine, batcher), registry=registry
     ).start()
+    # the continuous-batching A/B: a dedicated on/off batcher pair over
+    # the same engine, each with its own registry so the latency and
+    # occupancy histograms of the two policies never mix. max_batch sits
+    # BELOW the bucket it rounds into (9 -> the 16 bucket here), so
+    # formation closes with real pad slack for the dispatch-time pass to
+    # fill — the configuration continuous batching exists for.
+    slack_b = max(2, max_b // 2 + 1)
+    on_registry, off_registry = MetricsRegistry(), MetricsRegistry()
+    batcher_on = MicroBatcher(
+        engine, max_batch=slack_b, max_wait_ms=2.0, max_queue=8 * max_b,
+        registry=on_registry,
+    )
+    batcher_off = MicroBatcher(
+        engine, max_batch=slack_b, max_wait_ms=2.0, max_queue=8 * max_b,
+        continuous=False, registry=off_registry,
+    )
     requests = max(steps, 2)
     try:
         run_load(  # warmup: page executables + open keep-alive conns
-            HttpTarget(frontend.url), clients=2, requests_per_client=2,
-            seed=1,
+            HttpTarget(frontend.url, wire="binary"), clients=2,
+            requests_per_client=2, seed=1,
         )
         inproc = run_load(
             batcher, clients=8, requests_per_client=requests,
             images_max=8, seed=0,
         )
+        inproc_on = run_load(
+            batcher_on, clients=8, requests_per_client=requests,
+            images_max=8, seed=0,
+        )
+        inproc_off = run_load(
+            batcher_off, clients=8, requests_per_client=requests,
+            images_max=8, seed=0,
+        )
+        json_rep = run_load(
+            HttpTarget(frontend.url, wire="json"), clients=8,
+            requests_per_client=requests, images_max=8, seed=0,
+        )
         report = run_load(
-            HttpTarget(frontend.url), clients=8,
+            HttpTarget(frontend.url, wire="binary"), clients=8,
             requests_per_client=requests, images_max=8, seed=0,
         )
     finally:
         frontend.stop()
         batcher.close()
+        batcher_on.close()
+        batcher_off.close()
     assert engine.compile_count == len(engine.buckets), (
         "serving bench recompiled after warmup"
     )
@@ -800,7 +884,35 @@ def run_serve_http(model: str, batch: int, steps: int, compute_dtype) -> dict:
     report["http_vs_inproc"] = round(
         report["img_per_sec"] / max(inproc["img_per_sec"], 1e-9), 4
     )
+    # the wire-encoding A/B: binary frame vs the JSON (base64) protocol
+    report["wire_json_img_per_sec"] = round(json_rep["img_per_sec"], 3)
+    report["wire_json_p50_ms"] = round(json_rep["p50_ms"], 3)
+    report["wire_json_p95_ms"] = round(json_rep["p95_ms"], 3)
+    report["wire_json_p99_ms"] = round(json_rep["p99_ms"], 3)
+    report["wire_binary_vs_json"] = round(
+        report["img_per_sec"] / max(json_rep["img_per_sec"], 1e-9), 4
+    )
     s = registry.summary()
+    s_on = on_registry.summary()
+    s_off = off_registry.summary()
+    # continuous-batching A/B: admission-to-completion p50 at the
+    # occupancy each policy actually ran (equal offered load)
+    report["continuous"] = {
+        "max_batch": slack_b,
+        "p50_on_ms": round(s_on.get("serve.latency_ms.p50", 0.0), 3),
+        "p50_off_ms": round(s_off.get("serve.latency_ms.p50", 0.0), 3),
+        "occupancy_on": round(
+            s_on.get("serve.batch_occupancy.mean", 0.0), 4
+        ),
+        "occupancy_off": round(
+            s_off.get("serve.batch_occupancy.mean", 0.0), 4
+        ),
+        "admitted_requests": int(
+            s_on.get("serve.continuous_admitted", 0.0)
+        ),
+        "on_img_per_sec": round(inproc_on["img_per_sec"], 3),
+        "off_img_per_sec": round(inproc_off["img_per_sec"], 3),
+    }
     report["obs"] = {
         "http_requests": s.get("serve.http_requests", 0.0),
         "http_errors": s.get("serve.http_errors", 0.0),
@@ -811,6 +923,13 @@ def run_serve_http(model: str, batch: int, steps: int, compute_dtype) -> dict:
         "batch_occupancy_mean": round(
             s.get("serve.batch_occupancy.mean", 0.0), 4
         ),
+        # request decode cost + binary-frame count + staging reuse: the
+        # host half of the serve roofline (OBSERVABILITY.md)
+        "wire_requests": s.get("serve.wire_requests", 0.0),
+        "wire_decode_p95_ms": round(
+            s.get("serve.wire_decode_ms.p95", 0.0), 3
+        ),
+        "staging_reuse": s.get("serve.staging_reuse", 0.0),
     }
     return report
 
@@ -1176,6 +1295,8 @@ def main() -> int:
             # next to the total img/s `value`
             n_devices=report["n_devices"],
             img_per_sec_per_chip=report["img_per_sec_per_chip"],
+            # int8 bucket-lane A/B: accuracy-vs-throughput in one block
+            int8=report["int8"],
             obs=report["obs"],
         )
         name = f"serve_throughput_{args.model}_b{report['max_batch']}"
@@ -1200,6 +1321,14 @@ def main() -> int:
             n_devices=report["n_devices"],
             inproc_img_per_sec=report["inproc_img_per_sec"],
             http_vs_inproc=report["http_vs_inproc"],
+            # the wire-encoding A/B (`value` is the binary-wire img/s)
+            wire_json_img_per_sec=report["wire_json_img_per_sec"],
+            wire_json_p50_ms=report["wire_json_p50_ms"],
+            wire_json_p95_ms=report["wire_json_p95_ms"],
+            wire_json_p99_ms=report["wire_json_p99_ms"],
+            wire_binary_vs_json=report["wire_binary_vs_json"],
+            # the continuous-batching admission-to-completion A/B
+            continuous=report["continuous"],
             obs=report["obs"],
         )
         name = f"serve_http_{args.model}_b{report['max_batch']}"

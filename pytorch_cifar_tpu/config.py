@@ -225,6 +225,12 @@ class ServeConfig:
     # the single-chip engine exactly as before.
     num_devices: int = 0
     dtype: str = "bfloat16"  # serving compute dtype; logits return fp32
+    # int8 bucket lane (SERVING.md "int8 bucket lane"): weight-only
+    # symmetric per-channel quantization, AOT-compiled per bucket like
+    # any engine. NOT bit-identical to the fp engine — opt-in only,
+    # A/B'd for accuracy-vs-throughput (bench.py --serve int8 block) and
+    # vetted by the same canary gates before it may serve a fleet.
+    int8: bool = False
     mean: Tuple[float, float, float] = (0.4914, 0.4822, 0.4465)
     std: Tuple[float, float, float] = (0.2023, 0.1994, 0.2010)
 
@@ -239,6 +245,12 @@ class ServeConfig:
     # only when no interactive request is queued — a bulk flood can
     # never starve interactive traffic past its deadline
     bulk_share: float = 0.5
+    # continuous batching (SERVING.md): the worker admits newly queued
+    # requests into the pad slack of the bucket it is about to dispatch
+    # instead of closing admission at batch formation — same compiled
+    # programs, strictly more useful rows per device call. --no-continuous
+    # restores close-at-formation batching (the A/B escape hatch).
+    continuous: bool = True
     # per-request deadline: a request still queued this many ms after
     # submit fails fast with DeadlineExceeded instead of occupying a
     # coalesced batch (an engine stall otherwise strands every queued

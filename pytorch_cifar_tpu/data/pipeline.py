@@ -558,6 +558,74 @@ class DeviceDataset:
             )
 
 
+class StagingPool:
+    """Shape-keyed pool of reusable host staging buffers.
+
+    The serve hot path assembled every dispatched batch into a FRESH
+    allocation (the micro-batcher's ``np.concatenate``, the engine's
+    per-request pad buffer) feeding the same H2D put both
+    ``put_sharded_array`` callers make. Shape-bucketed serving means the
+    set of batch shapes is tiny and fixed, so those allocations are pure
+    allocator churn: this pool hands the SAME buffers back out,
+    round-robin per shape, and the batch-assembly copy writes into warm,
+    page-resident memory (the host-side analogue of a pinned staging
+    buffer — on runtimes with real pinned host allocation this is where
+    it would live).
+
+    Lifetime contract (the reason the pool is explicit acquire/release
+    and not hidden inside ``put_sharded_array``): a buffer may be
+    released only once NOTHING will read it again — for the serving
+    engine that is after the bucket call's D2H fetch completes, which
+    also covers any zero-copy ``device_put`` aliasing the host buffer.
+    The train/eval ``put_global`` caller deliberately stays un-pooled:
+    its batch outlives the put into a step whose completion the loader
+    never observes, so there is no safe release point there.
+
+    Thread-safe; at most ``max_per_shape`` buffers are retained per
+    shape (excess releases are dropped to the allocator), bounding the
+    arena even if a caller leaks acquisitions.
+    """
+
+    def __init__(self, max_per_shape: int = 4, registry=None):
+        self.max_per_shape = int(max_per_shape)
+        self._lock = threading.Lock()
+        self._free: dict = {}  # (shape, dtype-str) -> [ndarray, ...]
+        self._c_reuse = (
+            registry.counter("serve.staging_reuse")
+            if registry is not None
+            else None
+        )
+
+    def acquire(self, shape: Tuple[int, ...], dtype) -> np.ndarray:
+        """A writable buffer of exactly (shape, dtype) — reused when one
+        is free, freshly allocated otherwise. Contents are UNDEFINED:
+        the caller overwrites every byte it cares about (batch rows) and
+        zeroes the pad tail itself."""
+        key = (tuple(int(s) for s in shape), np.dtype(dtype).str)
+        with self._lock:
+            bufs = self._free.get(key)
+            if bufs:
+                buf = bufs.pop()
+                reused = True
+            else:
+                buf = None
+                reused = False
+        if reused:
+            if self._c_reuse is not None:
+                self._c_reuse.inc()
+            return buf
+        return np.empty(key[0], dtype=np.dtype(dtype))
+
+    def release(self, buf: np.ndarray) -> None:
+        """Return a buffer for reuse. Only call once no consumer (device
+        transfer included) will read it again."""
+        key = (tuple(buf.shape), buf.dtype.str)
+        with self._lock:
+            bufs = self._free.setdefault(key, [])
+            if len(bufs) < self.max_per_shape:
+                bufs.append(buf)
+
+
 def put_sharded_array(
     x: np.ndarray, sharding: jax.sharding.Sharding
 ) -> jax.Array:

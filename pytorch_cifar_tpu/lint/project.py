@@ -74,7 +74,12 @@ HOT_SEEDS: Sequence[Tuple[str, frozenset]] = (
     ("train/trainer.py",
      frozenset({"fit", "train_epoch", "eval_epoch", "finish"})),
     ("serve/engine.py", frozenset({"predict"})),
-    ("serve/batcher.py", frozenset({"_worker"})),
+    # the batcher's whole dispatch path: formation, the continuous-
+    # admission slack pass, and staged assembly all run per device call
+    # — seeded explicitly so a worker refactor cannot silently drop them
+    # out of host-sync scope
+    ("serve/batcher.py",
+     frozenset({"_worker", "_admit_slack_locked", "_assemble"})),
 )
 
 _THREAD_CTORS = ("threading.Thread", "Thread")

@@ -20,6 +20,10 @@ becomes a long-lived prediction service:
   bucket executables (``--aot_cache``), so a fresh replica cold-starts in
   load time with zero compiles — every import probe-verified
   (SERVING.md "AOT executable cache").
+- :mod:`~pytorch_cifar_tpu.serve.wire` is the zero-copy binary wire
+  format (``application/octet-stream`` frames on ``/predict``: raw
+  uint8 batch bytes in, raw float32 logit bytes out — no JSON parse,
+  no base64, no per-pixel host work; SERVING.md "Binary wire format"),
 - :mod:`~pytorch_cifar_tpu.serve.frontend` is the HTTP edge
   (``/predict`` + ``/healthz`` + live Prometheus ``/metrics`` over
   stdlib ``http.server``), and
@@ -63,3 +67,4 @@ from pytorch_cifar_tpu.serve.frontend import (  # noqa: F401
 )
 from pytorch_cifar_tpu.serve.reload import CheckpointWatcher  # noqa: F401
 from pytorch_cifar_tpu.serve.router import Router  # noqa: F401
+from pytorch_cifar_tpu.serve import wire  # noqa: F401

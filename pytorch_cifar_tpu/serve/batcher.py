@@ -36,6 +36,29 @@ piece that turns N independent clients into that shape:
   so interactive submits always find queue headroom. Interactive-lane
   FIFO order is unchanged from the single-lane batcher, and an all-
   interactive workload behaves bit-for-bit as before.
+- **Continuous batching** (``continuous``, default on): batch formation
+  closes at ``max_batch``/``max_wait_ms`` as before, but the worker
+  makes one more non-blocking admission pass at DISPATCH time, filling
+  the pad slack of the bucket program the formed batch is about to run
+  (``engine.bucket_for(total) - total`` rows that would otherwise carry
+  zero padding). A request that arrived after formation closed — or
+  that could not extend the batch past ``max_batch`` but fits the
+  bucket being dispatched anyway — rides the current device call
+  instead of waiting out a full engine cycle. The pass drains lanes in
+  priority order and never skips past a lane's head (per-lane FIFO is
+  preserved); letting bulk fill leftover slack delays no interactive
+  request — the batch departs immediately either way, the rows were
+  pads. The dispatched PROGRAM never changes (slack is bounded by the
+  bucket the formed total already selected), so ``compile_count`` stays
+  pinned. Admissions are counted in ``serve.continuous_admitted`` /
+  ``serve.continuous_images``; note a slack-filled batch may exceed
+  ``max_batch`` up to that bucket size (the occupancy histogram can
+  read > 1.0) — those rows were free.
+- **Staged assembly**: multi-request batches are copied straight into a
+  bucket-sized buffer from the engine's shared staging arena
+  (``data/pipeline.StagingPool``) with the pad tail zeroed, so the
+  engine pads nothing and the dispatch path allocates nothing
+  (``serve.staging_reuse``).
 - **Graceful drain**: ``close()`` rejects new submissions immediately,
   finishes everything already admitted (so accepted requests are never
   dropped), then stops the worker. ``close(drain=False)`` fails pending
@@ -103,6 +126,7 @@ class MicroBatcher:
         max_queue: int = 1024,
         default_deadline_ms: float = 0.0,
         bulk_share: float = 0.5,
+        continuous: bool = True,
         autostart: bool = True,
         registry: Optional[MetricsRegistry] = None,
     ):
@@ -135,6 +159,11 @@ class MicroBatcher:
         self._bulk_max = max(
             self.max_batch, int(self.max_queue * self.bulk_share)
         )
+        # continuous batching (module docstring): the dispatch-time
+        # slack-admission pass needs the engine's bucket table; engines
+        # without one (or continuous=False) keep the close-at-formation
+        # batcher exactly as before
+        self.continuous = bool(continuous) and hasattr(engine, "bucket_for")
         self._lanes = {p: deque() for p in PRIORITIES}
         self._queued_images = 0
         self._queued_bulk_images = 0
@@ -175,6 +204,11 @@ class MicroBatcher:
         )
         # admission -> result latency, the client-observed number
         self._h_latency = self.obs.histogram("serve.latency_ms")
+        # continuous-batching admissions: requests/images that rode the
+        # pad slack of an already-formed batch instead of waiting for
+        # the next engine cycle
+        self._c_cont_admitted = self.obs.counter("serve.continuous_admitted")
+        self._c_cont_images = self.obs.counter("serve.continuous_images")
         # per-shard valid-row occupancy of each dispatched batch (mesh
         # engines only): a ragged tail batch leaves trailing shards
         # padded — this histogram is how uneven the split actually ran
@@ -211,6 +245,7 @@ class MicroBatcher:
             "bulk_requests": int(self._c_bulk_requests.value),
             "bulk_rejected": int(self._c_bulk_rejected.value),
             "bulk_expired": int(self._c_bulk_expired.value),
+            "continuous_admitted": int(self._c_cont_admitted.value),
         }
 
     # -- client side ---------------------------------------------------
@@ -398,38 +433,117 @@ class MicroBatcher:
             for req in batch:
                 self._remove_accounting_locked(req)
             self._set_queue_gauges_locked()
-            self._c_batches.inc()
-            self._c_images.inc(total)
-            self._h_batch.observe(total)
-            self._h_occupancy.observe(total / self.max_batch)
-            if self._h_shard is not None:
-                for rows in self.engine.shard_split(total):
-                    self._h_shard.observe(rows)
         return batch
+
+    def _admit_slack_locked(self, batch, total: int) -> int:
+        """Continuous batching (module docstring): one non-blocking
+        admission pass at dispatch time, filling the pad slack of the
+        bucket ``total`` already selected. Lanes drain in priority
+        order; per-lane FIFO is preserved (a head that does not fit
+        ends that lane's pass — later requests are never reordered past
+        it). Returns the new total. Caller holds the condition."""
+        target = self.engine.bucket_for(total)
+        if target < total:
+            # total is past the largest bucket: the engine will chunk
+            # this batch — there is no single program with slack to fill
+            return total
+        admitted_reqs = admitted_imgs = 0
+        for p in PRIORITIES:
+            q = self._lanes[p]
+            while q and total < target:
+                head = q[0]
+                if (
+                    head.expires_at is not None
+                    and time.monotonic() >= head.expires_at
+                ):
+                    q.popleft()
+                    self._expire_locked(head, time.monotonic())
+                    continue
+                if total + head.n > target:
+                    break  # FIFO: never skip past a lane's head
+                q.popleft()
+                self._remove_accounting_locked(head)
+                batch.append(head)
+                total += head.n
+                admitted_reqs += 1
+                admitted_imgs += head.n
+            if total >= target:
+                break
+        if admitted_reqs:
+            self._c_cont_admitted.inc(admitted_reqs)
+            self._c_cont_images.inc(admitted_imgs)
+            self._set_queue_gauges_locked()
+        return total
+
+    def _account_dispatch_locked(self, total: int) -> None:
+        """Per-dispatch metrics for the finalized batch (caller holds
+        the condition)."""
+        self._c_batches.inc()
+        self._c_images.inc(total)
+        self._h_batch.observe(total)
+        self._h_occupancy.observe(total / self.max_batch)
+        if self._h_shard is not None:
+            for rows in self.engine.shard_split(total):
+                self._h_shard.observe(rows)
+
+    def _assemble(self, batch, total: int):
+        """Host assembly of one dispatch batch: ``(x, release)`` where
+        ``release`` (may be None) must be called once the engine call
+        has returned. Multi-request batches copy into a bucket-sized
+        buffer from the engine's staging arena with the pad tail zeroed
+        — the engine then pads nothing and the hot path allocates
+        nothing; single requests pass through untouched (zero copies).
+        Falls back to a plain concatenate for engines without a staging
+        pool or for chunked oversize batches."""
+        if len(batch) == 1:
+            return batch[0].x, None
+        pool = getattr(self.engine, "staging", None)
+        bucket = (
+            self.engine.bucket_for(total)
+            if hasattr(self.engine, "bucket_for")
+            else 0
+        )
+        if pool is None or bucket < total:
+            return np.concatenate([r.x for r in batch], axis=0), None
+        first = batch[0].x
+        buf = pool.acquire((bucket, *first.shape[1:]), first.dtype)
+        off = 0
+        for req in batch:
+            buf[off : off + req.n] = req.x
+            off += req.n
+        buf[off:] = 0  # pad rows are zeros (the engine's contract)
+        return buf, lambda: pool.release(buf)
 
     def _worker(self) -> None:
         while True:
             batch = self._take_batch()
             if not batch:
                 return
+            # dispatch-time slack admission + the per-dispatch metrics:
+            # a second lock acquisition AFTER formation released it, so
+            # requests submitted in between are visible to the pass
+            with self._cond:
+                total = sum(r.n for r in batch)
+                if self.continuous:
+                    total = self._admit_slack_locked(batch, total)
+                self._account_dispatch_locked(total)
             if not self._drain and self._closed:
                 for req in batch:
                     req.future.set_exception(
                         BatcherClosed("batcher closed without drain")
                     )
                 continue
-            x = (
-                batch[0].x
-                if len(batch) == 1
-                else np.concatenate([r.x for r in batch], axis=0)
-            )
+            x, release = self._assemble(batch, total)
             try:
-                with trace.span("serve/batch", images=int(x.shape[0])):
+                with trace.span("serve/batch", images=total):
                     out = self.engine.predict(x)
             except Exception as e:  # engine failure fails THIS batch only
                 for req in batch:
                     req.future.set_exception(e)
                 continue
+            finally:
+                if release is not None:
+                    release()
             off = 0
             done = time.perf_counter()
             for req in batch:

@@ -64,6 +64,58 @@ def round_buckets(buckets: Sequence[int], multiple: int) -> Tuple[int, ...]:
     return tuple(sorted({-(-int(b) // m) * m for b in buckets}))
 
 
+def _is_qleaf(leaf) -> bool:
+    """A quantized kernel leaf: the {"q": int8, "s": scale} pair
+    ``quantize_int8`` produces (no flax module in the zoo names params
+    'q'/'s', so the key set is an unambiguous tag)."""
+    return isinstance(leaf, dict) and set(leaf.keys()) == {"q", "s"}
+
+
+def quantize_int8(params):
+    """Weight-only symmetric int8 quantization of a host params tree.
+
+    Every kernel-shaped leaf (ndim >= 2: conv HWIO / dense IO) becomes
+    ``{"q": int8, "s": float32}`` with one scale per OUTPUT channel
+    (``s = max|w| / 127`` over all other axes — symmetric, zero-point
+    free, so dequantization is one multiply). Vectors (biases, BN
+    scale/bias) stay float: they are a rounding-error-sized fraction of
+    the bytes and quantizing them costs accuracy for nothing.
+    """
+    import jax
+
+    def q(v):
+        v = np.asarray(v)
+        if v.ndim < 2:
+            return v
+        axes = tuple(range(v.ndim - 1))
+        s = (
+            np.max(np.abs(v), axis=axes, keepdims=True).astype(np.float32)
+            / np.float32(127.0)
+        )
+        s = np.where(s == 0, np.float32(1.0), s).astype(np.float32)
+        return {
+            "q": np.clip(np.rint(v / s), -127, 127).astype(np.int8),
+            "s": s,
+        }
+
+    return jax.tree_util.tree_map(q, params)
+
+
+def dequantize_int8(params, dtype):
+    """In-graph inverse of :func:`quantize_int8`: q * s at the compute
+    dtype, leaving unquantized leaves untouched. Traced inside every
+    bucket program — the served weights stay int8 in device memory."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: (l["q"].astype(dtype) * l["s"].astype(dtype))
+        if _is_qleaf(l)
+        else l,
+        params,
+        is_leaf=_is_qleaf,
+    )
+
+
 def load_checkpoint_trees(
     ckpt: str, model_name: str, num_classes: int = 10
 ) -> Tuple[Any, Any, dict]:
@@ -188,6 +240,7 @@ class InferenceEngine:
         registry=None,
         mesh=None,
         aot_cache_dir: Optional[str] = None,
+        int8: bool = False,
     ):
         import jax.numpy as jnp
 
@@ -254,6 +307,14 @@ class InferenceEngine:
         mean = CIFAR10_MEAN if mean is None else tuple(mean)
         std = CIFAR10_STD if std is None else tuple(std)
         self._norm_mean, self._norm_std = mean, std  # cache-key identity
+        # int8 lane (SERVING.md "int8 bucket lane"): weight-only
+        # symmetric per-output-channel quantization applied at every
+        # weight set/swap — the bucket programs compile against the
+        # quantized avals and dequantize in-graph. NOT bit-identical to
+        # the fp engine (that is the point of the flag): served only
+        # when explicitly requested, A/B'd for accuracy-vs-throughput,
+        # and vetted by the same canary gates as any other engine.
+        self.int8 = bool(int8)
         # dtype=None -> fp32 module params/compute (the zoo convention);
         # bf16 modules match the trainer's amp policy
         model = create_model(
@@ -265,6 +326,8 @@ class InferenceEngine:
         )
 
         def fwd(params, batch_stats, x):
+            if self.int8:
+                params = dequantize_int8(params, self.compute_dtype)
             xn = normalize(x, mean, std, dtype=self.compute_dtype)
             logits = model.apply(
                 {"params": params, "batch_stats": batch_stats},
@@ -307,34 +370,79 @@ class InferenceEngine:
             if registry is not None and mesh is not None
             else None
         )
+        self._c_int8_requests = (
+            registry.counter("serve.int8_requests")
+            if registry is not None and self.int8
+            else None
+        )
+        self._c_int8_images = (
+            registry.counter("serve.int8_images")
+            if registry is not None and self.int8
+            else None
+        )
+        # host staging arena (data/pipeline.StagingPool): every pad /
+        # batch-assembly buffer on the predict path comes from here —
+        # the micro-batcher assembles coalesced batches straight into a
+        # bucket-sized buffer from the SAME pool (serve.staging_reuse)
+        from pytorch_cifar_tpu.data.pipeline import StagingPool
+
+        self.staging = StagingPool(registry=registry)
+        # the swap contract is stated in RAW (float) avals: callers hand
+        # swap_weights the same trees a checkpoint loads, whatever the
+        # engine does to them internally (int8 quantizes in _set_weights)
+        self._raw_avals = (
+            self._avals(params), self._avals(batch_stats or {})
+        )
+        self._raw_host = None  # int8 only: host originals for weights_host
         self._set_weights(params, batch_stats)
         if warmup:
             self.warmup()
 
     # -- weights -------------------------------------------------------
 
-    def _set_weights(self, params, batch_stats) -> None:
+    def _prepare_weights(self, params, batch_stats):
+        """Everything expensive about a weight set — the int8 fetch +
+        quantization and the H2D put — OFF any lock; returns the
+        ``(weights, raw_host)`` pair the swap assigns. One H2D put at
+        swap time, not per request. With a mesh the put is REPLICATED
+        over every device — the hot-reload watcher routes through here
+        too (swap_weights), so a checkpoint swap lands on all chips in
+        the same single assignment. parallel.replicate rather than a raw
+        device_put: it sidesteps jax 0.4.x's fragile per-leaf gloo
+        assert broadcast under multi-process meshes."""
         import jax
 
-        # one H2D put at swap time, not per request. With a mesh the put is
-        # REPLICATED over every device — the hot-reload watcher routes
-        # through here too (swap_weights), so a checkpoint swap lands on
-        # all chips in the same single assignment. parallel.replicate
-        # rather than a raw device_put: it sidesteps jax 0.4.x's fragile
-        # per-leaf gloo assert broadcast under multi-process meshes.
+        raw_host = None
+        if self.int8:
+            # keep the RAW host trees: weights_host must return what a
+            # caller can swap back in (the canary rollback contract),
+            # and that is the float originals, not the int8 encoding
+            raw_host = jax.device_get((params, batch_stats or {}))
+            params = quantize_int8(raw_host[0])
+            batch_stats = raw_host[1]
         if self.mesh is not None:
             from pytorch_cifar_tpu.parallel import replicate
 
-            self._weights = replicate((params, batch_stats or {}), self.mesh)
+            weights = replicate((params, batch_stats or {}), self.mesh)
         else:
-            self._weights = jax.device_put((params, batch_stats or {}))
+            weights = jax.device_put((params, batch_stats or {}))
+        return weights, raw_host
+
+    def _set_weights(self, params, batch_stats) -> None:
+        prepared = self._prepare_weights(params, batch_stats)
+        with self._swap_lock:
+            self._weights, self._raw_host = prepared
 
     def weights_host(self):
         """Host-numpy copies of the served ``(params, batch_stats)``
         trees — the rollback snapshot the canary promotion controller
-        swaps back to after rejecting a candidate (serve/canary.py)."""
+        swaps back to after rejecting a candidate (serve/canary.py).
+        An int8 engine returns the float ORIGINALS (what swap_weights
+        accepts), not the quantized encoding it serves from."""
         import jax
 
+        if self.int8:
+            return jax.tree_util.tree_map(np.copy, self._raw_host)
         return jax.device_get(self._weights)
 
     @staticmethod
@@ -360,20 +468,26 @@ class InferenceEngine:
         pre-compiled executables stay valid, so a wrong-model checkpoint
         fails HERE instead of poisoning the serving path. In-flight
         requests keep the weight tuple they already captured; nothing is
-        dropped.
+        dropped. The comparison is against the RAW avals captured at
+        construction — an int8 engine still takes (and re-quantizes) the
+        same float trees a checkpoint loads.
         """
-        old_p, old_s = self._weights
+        raw_p, raw_s = self._raw_avals
         for old, new, kind in (
-            (old_p, params, "params"),
-            (old_s, batch_stats or {}, "batch_stats"),
+            (raw_p, params, "params"),
+            (raw_s, batch_stats or {}, "batch_stats"),
         ):
-            if self._avals(old) != self._avals(new):
+            if old != self._avals(new):
                 raise ValueError(
                     f"refusing weight swap: new {kind} tree does not match "
                     f"the compiled program's avals (different model/config?)"
                 )
+        # fetch/quantize/put OUTSIDE the lock (graftcheck
+        # blocking-under-lock: a D2H stall here would freeze every
+        # contending swapper); the critical section is two assignments
+        prepared = self._prepare_weights(params, batch_stats)
         with self._swap_lock:
-            self._set_weights(params, batch_stats)
+            self._weights, self._raw_host = prepared
             self.version += 1
         return self.version
 
@@ -430,6 +544,7 @@ class InferenceEngine:
             else "bfloat16",
             "mean": [float(v) for v in self._norm_mean],
             "std": [float(v) for v in self._norm_std],
+            "int8": bool(self.int8),
             "n_devices": int(self.n_devices),
             "mesh": list(self.mesh.devices.shape) if self.mesh is not None
             else None,
@@ -463,6 +578,23 @@ class InferenceEngine:
             return getattr(a, "dtype", None) or np.asarray(a).dtype
 
         def fill_param(a):
+            if _is_qleaf(a):
+                # int8 lane: a representative quantized kernel — full
+                # int8 range (every bit pattern the dequant multiply can
+                # see) with fan-in-scaled positive scales so activations
+                # stay O(1) through the dequantized forward
+                q_shape, s_shape = np.shape(a["q"]), np.shape(a["s"])
+                fan_in = int(np.prod(q_shape[:-1])) if len(q_shape) >= 2 else 1
+                return {
+                    "q": jnp.asarray(
+                        rs.randint(-127, 128, size=q_shape), dtype=jnp.int8
+                    ),
+                    "s": jnp.asarray(
+                        rs.uniform(0.5, 1.5, size=s_shape)
+                        / (127.0 * np.sqrt(max(fan_in, 1))),
+                        dtype=jnp.float32,
+                    ),
+                }
             shape = np.shape(a)
             fan_in = int(np.prod(shape[:-1])) if len(shape) >= 2 else 1
             arr = rs.standard_normal(shape) / np.sqrt(max(fan_in, 1))
@@ -475,7 +607,7 @@ class InferenceEngine:
 
         params, stats = self._weights
         tree = (
-            jax.tree_util.tree_map(fill_param, params),
+            jax.tree_util.tree_map(fill_param, params, is_leaf=_is_qleaf),
             jax.tree_util.tree_map(fill_stat, stats),
         )
         if self.mesh is not None:
@@ -667,18 +799,29 @@ class InferenceEngine:
         return out
 
     def _run_bucket(self, x: np.ndarray) -> np.ndarray:
-        """One padded executable call: len(x) <= max bucket."""
+        """One padded executable call: len(x) <= max bucket. Padding
+        assembles into a reusable buffer from :attr:`staging` instead of
+        a fresh allocation per request; the buffer is released only
+        after the D2H fetch — by then the executable has consumed the
+        input, even if the H2D put aliased the host buffer."""
         n = x.shape[0]
         b = self.bucket_for(n)
+        staged = None
         if n < b:
-            pad = np.zeros((b - n, *self.image_shape), x.dtype)
-            x = np.concatenate([x, pad], axis=0)
+            staged = self.staging.acquire((b, *self.image_shape), x.dtype)
+            staged[:n] = x
+            staged[n:] = 0  # pad rows are zeros (bit-identity contract)
+            x = staged
         params, stats = self._weights  # atomic tuple read
         t0 = time.perf_counter()
-        with trace.span("serve/bucket_forward", bucket=b, n=n):
-            out = self._compiled[b](params, stats, self._put_batch(x))
-            # graftcheck: noqa[host-sync] -- the ONE sanctioned D2H sync of the dispatch path: callers receive host logits, so this fetch IS the result (everything upstream stays async)
-            res = np.asarray(out)[:n]  # D2H: waits for the execution
+        try:
+            with trace.span("serve/bucket_forward", bucket=b, n=n):
+                out = self._compiled[b](params, stats, self._put_batch(x))
+                # graftcheck: noqa[host-sync] -- the ONE sanctioned D2H sync of the dispatch path: callers receive host logits, so this fetch IS the result (everything upstream stays async)
+                res = np.asarray(out)[:n]  # D2H: waits for the execution
+        finally:
+            if staged is not None:
+                self.staging.release(staged)
         if self._h_device is not None:
             self._h_device.observe((time.perf_counter() - t0) * 1e3)
         return res
@@ -697,6 +840,9 @@ class InferenceEngine:
             )
         if not self._compiled:
             raise RuntimeError("engine not warmed up — call warmup() first")
+        if self._c_int8_requests is not None:
+            self._c_int8_requests.inc()
+            self._c_int8_images.inc(int(x.shape[0]))
         n, cap = x.shape[0], self.buckets[-1]
         if n <= cap:
             return self._run_bucket(x)

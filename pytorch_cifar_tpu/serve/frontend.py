@@ -5,14 +5,22 @@ only traffic source was an in-process load generator. This module is the
 network edge in front of it — stdlib-only (``http.server``), because the
 serving path must not grow a web-framework dependency for three routes:
 
-- ``POST /predict`` — JSON body carrying a uint8 NHWC image batch
+- ``POST /predict`` — a JSON body carrying a uint8 NHWC image batch
   (base64-packed bytes + ``shape``, or nested lists), optional
   ``deadline_ms`` and ``priority`` (``interactive``/``bulk``, the
   batcher's lanes), optional ``encoding: "b64"`` for a packed float32
-  response. Returns fp32 logits (bit-identical to an in-process
-  ``engine.predict`` of the same rows — JSON floats round-trip float32
-  exactly through float64 repr) plus argmax labels and the engine
-  version that answered.
+  response — OR, with ``Content-Type: application/octet-stream``, the
+  zero-copy binary frame (``serve/wire.py``; SERVING.md "Binary wire
+  format"): a 24-byte header plus the batch's raw bytes, decoded into a
+  NumPy view with no JSON parse and no base64, answered with a raw
+  float32 logits frame (or JSON, when the frame's flag asks). All
+  encodings return logits bit-identical to an in-process
+  ``engine.predict`` of the same rows (JSON floats round-trip float32
+  exactly through float64 repr; the binary frame is the float32 bytes
+  themselves). Malformed frames — truncated, bad magic/version/dtype,
+  header/payload length mismatch, oversized ``n`` — are 400s with a
+  JSON error body naming the defect, never 500s or hangs; an oversized
+  Content-Length is rejected before the body is even read.
 - ``GET /healthz`` — engine + checkpoint generation: model, engine
   weight version (bumped by every hot-reload swap), checkpoint epoch,
   compile/AOT-cache counts, queue stats. 200 while serving, 503 once
@@ -66,6 +74,7 @@ import numpy as np
 
 from pytorch_cifar_tpu.obs import MetricsRegistry
 from pytorch_cifar_tpu.obs.export import prometheus_text
+from pytorch_cifar_tpu.serve import wire
 from pytorch_cifar_tpu.serve.batcher import (
     PRIORITIES,
     BatcherClosed,
@@ -349,6 +358,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_bytes(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _error(self, code: int, message: str) -> None:
         fe = self.server.frontend
         fe.c_http_errors.inc()
@@ -408,14 +424,39 @@ class _Handler(BaseHTTPRequestHandler):
             if length <= 0:
                 self._error(400, "missing request body")
                 return
-            body = self.rfile.read(length)
-            try:
-                x, deadline_ms, priority, encoding = decode_predict_request(
-                    body, fe.image_shape
+            binary = wire.is_binary_content_type(
+                self.headers.get("Content-Type")
+            )
+            if binary and length > wire.max_request_bytes(
+                fe.image_shape, MAX_IMAGES_PER_REQUEST
+            ):
+                # oversized n rejected from the Content-Length alone —
+                # before the body costs a read, let alone a decode
+                self._error(
+                    400,
+                    f"binary frame of {length} bytes exceeds the "
+                    f"{MAX_IMAGES_PER_REQUEST}-image request cap",
                 )
-            except ValueError as e:
+                return
+            body = self.rfile.read(length)
+            t_dec = time.perf_counter()
+            try:
+                if binary:
+                    x, deadline_ms, priority, json_resp = (
+                        wire.decode_request(
+                            body, fe.image_shape, MAX_IMAGES_PER_REQUEST
+                        )
+                    )
+                    encoding = "json" if json_resp else "binary"
+                    fe.c_wire_requests.inc()
+                else:
+                    x, deadline_ms, priority, encoding = (
+                        decode_predict_request(body, fe.image_shape)
+                    )
+            except (wire.WireError, ValueError) as e:
                 self._error(400, str(e))
                 return
+            fe.h_wire_decode.observe((time.perf_counter() - t_dec) * 1e3)
             try:
                 logits = fe.backend.predict(
                     x, deadline_ms=deadline_ms, priority=priority
@@ -438,12 +479,19 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             fe.c_http_images.inc(int(x.shape[0]))
             fe.h_http_ms.observe((time.perf_counter() - t0) * 1e3)
-            self._send_json(
-                200,
-                encode_predict_response(
-                    logits, encoding, fe.backend_version()
-                ),
-            )
+            if encoding == "binary":
+                self._send_bytes(
+                    200,
+                    wire.encode_response(logits, fe.backend_version()),
+                    wire.CONTENT_TYPE,
+                )
+            else:
+                self._send_json(
+                    200,
+                    encode_predict_response(
+                        logits, encoding, fe.backend_version()
+                    ),
+                )
         finally:
             if self.server.track(self, busy=False):
                 self.close_connection = True
@@ -475,6 +523,11 @@ class ServingFrontend:
         self.c_http_images = self.registry.counter("serve.http_images")
         self.c_http_errors = self.registry.counter("serve.http_errors")
         self.h_http_ms = self.registry.histogram("serve.http_ms")
+        # wire-path observability: binary-frame request count and the
+        # request decode cost (both encodings — the number the binary
+        # format exists to shrink)
+        self.c_wire_requests = self.registry.counter("serve.wire_requests")
+        self.h_wire_decode = self.registry.histogram("serve.wire_decode_ms")
         self._server = _Server((host, int(port)), self)
         self.host, self.port = self._server.server_address[:2]
         # accept-loop thread handle: shared with stop() (lock per

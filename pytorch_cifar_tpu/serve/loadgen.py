@@ -26,7 +26,9 @@ exactly that surface (one persistent HTTP/1.1 connection per client
 thread; 429/504/503 map back to ``QueueFull``/``DeadlineExceeded``/
 ``BatcherClosed``), so ``bench.py --serve-http`` and the router chaos
 drill report the SAME closed-loop stats and hedge counters through the
-full network path that the in-process numbers mean.
+full network path that the in-process numbers mean. ``wire=`` picks the
+request encoding per target — JSON, the zero-copy binary frame, or a
+mixed fleet of both (SERVING.md "Binary wire format").
 
 **Mixed-priority load**: ``bulk_fraction`` tags that share of requests
 ``priority="bulk"`` (per-client deterministic rng), exercising the
@@ -75,6 +77,13 @@ class HttpTarget:
     gets its own persistent HTTP/1.1 connection (``threading.local``),
     reconnecting transparently when the server idles one out.
 
+    ``wire`` picks the request encoding: ``"json"`` (the base64-packed
+    JSON protocol every earlier round reported), ``"binary"`` (the
+    zero-copy frame of ``serve/wire.py`` — raw bytes both ways), or
+    ``"mixed"`` (each client thread alternates encodings per request —
+    the chaos drills' fleet-realism mode: one fleet, heterogeneous
+    clients).
+
     Error mapping is the frontend contract in reverse: 429 raises
     :class:`QueueFull` (the client backs off and retries), 504 raises
     :class:`DeadlineExceeded` (the client hedges once), 503 and
@@ -87,15 +96,21 @@ class HttpTarget:
         *,
         deadline_ms: Optional[float] = None,
         timeout_s: float = 60.0,
+        wire: str = "json",
     ):
         parts = urlsplit(url if "//" in url else f"http://{url}")
         if parts.scheme != "http" or not parts.hostname:
             raise ValueError(f"target url must be http://host:port: {url!r}")
+        if wire not in ("json", "binary", "mixed"):
+            raise ValueError(
+                f"wire must be 'json', 'binary', or 'mixed': {wire!r}"
+            )
         self.host = parts.hostname
         self.tcp_port = int(parts.port or 80)
         self.url = f"http://{self.host}:{self.tcp_port}"
         self.deadline_ms = deadline_ms
         self.timeout_s = float(timeout_s)
+        self.wire = wire
         self._local = threading.local()
         self.obs = None  # loadgen's optional registry hook (run_load)
 
@@ -127,28 +142,45 @@ class HttpTarget:
         priority: str = "interactive",
     ) -> _Resolved:
         """One synchronous ``POST /predict``; returns a resolved future
-        of the fp32 logits (b64-packed on the wire: bit-identical to the
-        server's array)."""
+        of the fp32 logits (b64-packed JSON or a raw binary frame on the
+        wire, per ``wire``: bit-identical to the server's array either
+        way)."""
+        from pytorch_cifar_tpu.serve import wire as wire_mod
         from pytorch_cifar_tpu.serve.frontend import decode_logits
 
         x = np.ascontiguousarray(np.asarray(images, dtype=np.uint8))
-        req = {
-            "images": base64.b64encode(x.tobytes()).decode("ascii"),
-            "shape": [int(v) for v in x.shape],
-            "priority": priority,
-            "encoding": "b64",
-        }
         if deadline_ms is None:
             deadline_ms = self.deadline_ms
-        if deadline_ms:
-            req["deadline_ms"] = float(deadline_ms)
-        body = json.dumps(req).encode("utf-8")
+        binary = self.wire == "binary"
+        if self.wire == "mixed":
+            # per-thread alternation: deterministic, no coordination
+            seq = getattr(self._local, "seq", 0)
+            self._local.seq = seq + 1
+            binary = seq % 2 == 0
+        if binary:
+            body = wire_mod.encode_request(
+                x,
+                deadline_ms=float(deadline_ms) if deadline_ms else None,
+                priority=priority,
+            )
+            ctype = wire_mod.CONTENT_TYPE
+        else:
+            req = {
+                "images": base64.b64encode(x.tobytes()).decode("ascii"),
+                "shape": [int(v) for v in x.shape],
+                "priority": priority,
+                "encoding": "b64",
+            }
+            if deadline_ms:
+                req["deadline_ms"] = float(deadline_ms)
+            body = json.dumps(req).encode("utf-8")
+            ctype = "application/json"
         for attempt in (0, 1):
             try:
                 conn = self._conn(fresh=attempt > 0)
                 conn.request(
                     "POST", "/predict", body=body,
-                    headers={"Content-Type": "application/json"},
+                    headers={"Content-Type": ctype},
                 )
                 resp = conn.getresponse()
                 payload = resp.read()
@@ -166,6 +198,9 @@ class HttpTarget:
                 ) from None
             break
         if status == 200:
+            if binary:
+                logits, _version = wire_mod.decode_response(payload)
+                return _Resolved(logits)
             return _Resolved(decode_logits(json.loads(payload)))
         try:
             err = json.loads(payload).get("error", "")

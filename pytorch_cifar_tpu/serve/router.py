@@ -32,15 +32,19 @@ from a fleet. Responsibilities (SERVING.md "HTTP frontend & router"):
   client, not consume a second replica's bulk budget (the fleet-level
   complement of the batcher's lane cap).
 
-Wire protocol: the frontend's own (``serve/frontend.py``) — requests are
-re-encoded once and replayed verbatim on hedge, responses are
-``b64``-packed float32 logits, so the bytes a client receives through
-the router are bit-identical to the replica's answer.
+Wire protocol: the binary frame (``serve/wire.py``; SERVING.md "Binary
+wire format") — the request is encoded ONCE into a buffered frame whose
+raw bytes are replayed in full on every attempt (a hedge or a
+stale-connection retry resends the complete frame from the buffer, never
+a half-consumed stream), and the response is the replica's raw float32
+logit bytes — so the bytes a client receives through the router are
+bit-identical to the replica's answer whatever encoding the CLIENT
+spoke (the frontend decodes client JSON or binary into the same array
+this router re-frames).
 """
 
 from __future__ import annotations
 
-import base64
 import http.client
 import json
 import logging
@@ -53,12 +57,12 @@ from urllib.parse import urlsplit
 import numpy as np
 
 from pytorch_cifar_tpu.obs import MetricsRegistry
+from pytorch_cifar_tpu.serve import wire
 from pytorch_cifar_tpu.serve.batcher import (
     BatcherClosed,
     DeadlineExceeded,
     QueueFull,
 )
-from pytorch_cifar_tpu.serve.frontend import decode_logits
 
 log = logging.getLogger(__name__)
 
@@ -122,11 +126,17 @@ class Replica:
         path: str,
         body: Optional[bytes] = None,
         timeout_s: Optional[float] = None,
+        content_type: str = "application/json",
+        raw: bool = False,
     ):
-        """One HTTP exchange; returns ``(status, payload_dict)``. A stale
-        keep-alive connection (server idled it out) gets ONE transparent
-        reconnect; real failures raise :class:`ReplicaError`."""
-        headers = {"Content-Type": "application/json"} if body else {}
+        """One HTTP exchange; returns ``(status, payload_dict)`` — or
+        ``(status, payload_bytes)`` with ``raw=True`` and a 200 (error
+        payloads are always JSON and decoded either way). ``body`` is a
+        fully buffered bytes object, so a stale keep-alive connection
+        (server idled it out) gets ONE transparent reconnect that
+        resends the COMPLETE body — a binary frame is never replayed
+        from a half-consumed stream."""
+        headers = {"Content-Type": content_type} if body else {}
         for attempt in (0, 1):
             conn = None
             try:
@@ -153,6 +163,8 @@ class Replica:
                     sock = getattr(conn, "sock", None)
                     if sock is not None:
                         sock.settimeout(self.timeout_s)
+            if raw and status == 200:
+                return status, payload
             try:
                 obj = json.loads(payload.decode("utf-8")) if payload else {}
             except ValueError:
@@ -291,7 +303,8 @@ class Router:
             )
         try:
             status, resp = replica.request(
-                "POST", "/predict", body, timeout_s=timeout_s
+                "POST", "/predict", body, timeout_s=timeout_s,
+                content_type=wire.CONTENT_TYPE, raw=True,
             )
         except ReplicaError as e:
             # connection refused/reset/timeout: the replica-death signal
@@ -301,8 +314,18 @@ class Router:
             with self._lock:
                 replica.in_flight -= 1
         if status == 200:
+            try:
+                logits, _version = wire.decode_response(resp)
+            except wire.WireError as e:
+                # a 200 carrying an undecodable frame is replica damage:
+                # count the failure (eviction pressure) and let the
+                # caller hedge the buffered frame to another replica
+                self._mark_failure(replica, f"bad response frame: {e}")
+                raise ReplicaError(
+                    f"{replica.url}: undecodable response frame: {e}"
+                ) from None
             self._mark_success(replica)
-            return decode_logits(resp)
+            return logits
         err = resp.get("error", f"http {status}")
         if status == 429:
             # admission control, not replica damage: no failure mark
@@ -325,15 +348,14 @@ class Router:
         handling). Raises the batcher exception types so callers — the
         frontend above all — need no router-specific error handling."""
         x = np.ascontiguousarray(np.asarray(images, dtype=np.uint8))
-        req = {
-            "images": base64.b64encode(x.tobytes()).decode("ascii"),
-            "shape": [int(v) for v in x.shape],
-            "priority": priority,
-            "encoding": "b64",
-        }
-        if deadline_ms:
-            req["deadline_ms"] = float(deadline_ms)
-        body = json.dumps(req).encode("utf-8")
+        # ONE buffered binary frame (serve/wire.py) per request: every
+        # attempt — first dispatch, stale-connection retry, cross-replica
+        # hedge — resends these exact bytes in full
+        body = wire.encode_request(
+            x,
+            deadline_ms=float(deadline_ms) if deadline_ms else None,
+            priority=priority,
+        )
         # per-attempt HTTP timeout: the deadline bounds queue time on the
         # replica; the wire timeout must outlive deadline + service time,
         # and never be shorter than the configured floor

@@ -174,6 +174,8 @@ def main() -> int:
         # bucket programs instead of recompiling (verified by probe) —
         # the autoscaling cold-start path
         aot_cache_dir=cfg.aot_cache or None,
+        # opt-in quantized lane (SERVING.md "int8 bucket lane")
+        int8=cfg.int8,
     )
     print(
         f"==> warm: {engine.compile_count} bucket programs compiled, "
@@ -220,6 +222,8 @@ def main() -> int:
         # priority lanes: bulk capped to this share of the queue and
         # dispatched only behind interactive traffic (SERVING.md)
         bulk_share=cfg.bulk_share,
+        # continuous batching: dispatch-time slack admission (SERVING.md)
+        continuous=cfg.continuous,
         registry=registry,
     )
     exporter = None
@@ -320,6 +324,17 @@ def main() -> int:
             "expired": obs_summary.get("serve.expired", 0.0),
             "hedged": obs_summary.get("serve.hedged", 0.0),
             "reloads": obs_summary.get("serve.reload.reloads", 0.0),
+            # serve-roofline counters (SERVING.md): binary-frame traffic,
+            # request decode cost, staging-arena reuse, and dispatch-slack
+            # admissions — the wire/host-gap numbers next to device time
+            "wire_requests": obs_summary.get("serve.wire_requests", 0.0),
+            "wire_decode_p95_ms": round(
+                obs_summary.get("serve.wire_decode_ms.p95", 0.0), 3
+            ),
+            "staging_reuse": obs_summary.get("serve.staging_reuse", 0.0),
+            "continuous_admitted": obs_summary.get(
+                "serve.continuous_admitted", 0.0
+            ),
         },
     }
     print(json.dumps(out))

@@ -34,6 +34,7 @@ import urllib.request
 import numpy as np
 import pytest
 
+from pytorch_cifar_tpu.serve import wire
 from pytorch_cifar_tpu.serve.batcher import (
     BatcherClosed,
     DeadlineExceeded,
@@ -173,6 +174,154 @@ def test_predict_with_deadline_and_priority_fields(lenet_stack):
     )
     assert status == 200
     assert np.array_equal(decode_logits(resp), engine.predict(x))
+
+
+# -- binary wire format (serve/wire.py; SERVING.md) --------------------
+
+
+def _post_binary(url, frame, timeout=30):
+    req = urllib.request.Request(
+        url + "/predict", data=frame,
+        headers={"Content-Type": wire.CONTENT_TYPE},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_wire_frame_roundtrip_unit():
+    """The frame codec in isolation: request and response survive an
+    encode/decode round trip byte-exactly, with every header field
+    (deadline, priority, response-encoding flag) preserved."""
+    x = _images(4, seed=11)
+    for deadline, priority, json_resp in (
+        (None, "interactive", False),
+        (250.0, "bulk", False),
+        (0.0, "interactive", True),
+    ):
+        frame = wire.encode_request(
+            x, deadline_ms=deadline, priority=priority,
+            json_response=json_resp,
+        )
+        x2, d2, p2, j2 = wire.decode_request(frame, (32, 32, 3), 4096)
+        assert np.array_equal(x2, x)
+        assert d2 == deadline and p2 == priority and j2 == json_resp
+    logits = np.random.RandomState(3).randn(4, 10).astype(np.float32)
+    out, version = wire.decode_response(wire.encode_response(logits, 9))
+    assert np.array_equal(out, logits) and version == 9
+
+
+def test_predict_binary_frame_bit_identical(lenet_stack):
+    """The tentpole contract on the new wire: a binary request frame
+    answered with a binary logits frame is bit-identical to an
+    in-process engine.predict — the payload IS the float32 bytes, so
+    there is no text round-trip to reason about. The frame's deadline
+    and bulk-priority flags ride through the same path."""
+    engine, _, frontend = lenet_stack
+    x = _images(5, seed=21)  # off-bucket: staging-pad path included
+    status, ctype, body = _post_binary(
+        frontend.url, wire.encode_request(x)
+    )
+    assert status == 200 and ctype == wire.CONTENT_TYPE
+    logits, version = wire.decode_response(body)
+    assert np.array_equal(logits, engine.predict(x))
+    assert version == engine.version
+    # flags: generous deadline + bulk lane still answer correctly
+    status, _, body = _post_binary(
+        frontend.url,
+        wire.encode_request(x, deadline_ms=30000, priority="bulk"),
+    )
+    assert status == 200
+    assert np.array_equal(wire.decode_response(body)[0], engine.predict(x))
+
+
+def test_predict_binary_frame_json_response_flag(lenet_stack):
+    """A binary request may ask for a JSON response (bit-identical too:
+    float32 survives JSON through float64 repr) — the migration path
+    for clients that can encode frames but still parse JSON."""
+    engine, _, frontend = lenet_stack
+    x = _images(2, seed=22)
+    req = urllib.request.Request(
+        frontend.url + "/predict",
+        data=wire.encode_request(x, json_response=True),
+        headers={"Content-Type": wire.CONTENT_TYPE},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+        obj = json.load(resp)
+    assert np.array_equal(decode_logits(obj), engine.predict(x))
+
+
+def test_malformed_binary_frames_get_400():
+    """Frame hardening (the satellite contract): truncated header,
+    truncated payload, header/payload length mismatch, bad
+    magic/version/dtype/frame-type, reserved flag bits, n == 0, wrong
+    image shape, and an oversized n all map to 400 with a parseable
+    JSON error body — never a 500, never a hang — and none may reach
+    the backend. An oversized Content-Length is refused before the
+    body is read at all."""
+    stub = StubBackend()
+    good = wire.encode_request(_images(2, seed=1))
+    # n=5000 > the 4096 cap: rejected from the header alone, before the
+    # (absent) payload could matter — a client cannot buy a decode by
+    # lying about n (a TRUTHFUL 5000-image Content-Length is refused
+    # even earlier, before the body is read; wire.max_request_bytes)
+    oversized = wire._HEADER.pack(
+        wire.MAGIC, wire.VERSION, wire.FRAME_PREDICT, wire.DTYPE_UINT8,
+        0, 5000, 32, 32, 3,
+    )
+    cases = [
+        b"",  # empty — caught by the missing-body check
+        good[:10],  # truncated header
+        good[:-7],  # truncated payload (length mismatch)
+        good + b"XX",  # payload longer than the header promises
+        b"XXXX" + good[4:],  # bad magic
+        good[:4] + bytes([99]) + good[5:],  # unsupported version
+        good[:5] + bytes([wire.FRAME_LOGITS]) + good[6:],  # wrong frame
+        good[:6] + bytes([wire.DTYPE_FLOAT32]) + good[7:],  # bad dtype
+        good[:7] + bytes([0x80]) + good[8:],  # reserved flag bits
+        wire._HEADER.pack(  # n == 0
+            wire.MAGIC, wire.VERSION, wire.FRAME_PREDICT,
+            wire.DTYPE_UINT8, 0, 0, 32, 32, 3,
+        ),
+        wire._HEADER.pack(  # wrong image shape
+            wire.MAGIC, wire.VERSION, wire.FRAME_PREDICT,
+            wire.DTYPE_UINT8, 0, 1, 64, 64, 3,
+        ) + b"\0" * (64 * 64 * 3),
+        oversized,
+    ]
+    with ServingFrontend(stub) as fe:
+        for body in cases:
+            req = urllib.request.Request(
+                fe.url + "/predict", data=body,
+                headers={"Content-Type": wire.CONTENT_TYPE},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400, body[:32]
+            err = json.load(ei.value)
+            assert "error" in err and err["error"], body[:32]
+    assert stub.calls == 0
+
+
+def test_http_target_binary_and_mixed_wire(lenet_stack):
+    """The loadgen's wire modes over a real stack: binary and mixed
+    closed loops finish with zero failures and bit-identical answers."""
+    engine, _, frontend = lenet_stack
+    x = _images(3, seed=23)
+    want = engine.predict(x)
+    for mode in ("binary", "mixed"):
+        target = HttpTarget(frontend.url, wire=mode)
+        # two submits so "mixed" exercises BOTH encodings on this thread
+        assert np.array_equal(target.submit(x).result(), want)
+        assert np.array_equal(target.submit(x).result(), want)
+        rep = run_load(
+            target, clients=2, requests_per_client=4, images_max=3,
+            seed=5,
+        )
+        target.close()
+        assert rep["failed"] == 0 and rep["requests"] == 8
+    with pytest.raises(ValueError):
+        HttpTarget(frontend.url, wire="carrier-pigeon")
 
 
 # -- /healthz ----------------------------------------------------------
@@ -419,6 +568,66 @@ def test_router_priority_aware_admission():
                 out = r.predict(_images(1), priority="interactive")
                 assert float(out[0, 0]) == 2.0  # spilled to the survivor
             assert r.stats["rejected"] >= 1  # the bulk rejections
+
+
+def test_router_binary_hedge_resends_full_frame():
+    """The binary-wire hedge regression (satellite contract): a hedged
+    retry must resend the COMPLETE buffered frame, never a half-consumed
+    stream. Replica A fails every request (500 after consuming the
+    body); the hedge to replica B must deliver a frame B can fully
+    decode — pinned by B answering with logits for exactly the rows
+    sent, for a request large enough to span many socket reads."""
+
+    class CountingStub(StubBackend):
+        def __init__(self, tag=1.0, raises=None):
+            super().__init__(tag=tag, raises=raises)
+            self.seen_rows = []
+
+        def predict(self, images, deadline_ms=None, priority="interactive"):
+            with self._lock:
+                self.seen_rows.append(int(images.shape[0]))
+            return super().predict(images, deadline_ms, priority)
+
+    dead = CountingStub(raises=RuntimeError("boom"))  # 500 every time
+    ok = CountingStub(tag=3.0)
+    with ServingFrontend(dead) as fd, ServingFrontend(ok) as fo:
+        with Router([fd.url, fo.url], fail_after=100) as r:
+            x = _images(256, seed=31)  # 786 KiB payload: not one recv()
+            hedged = 0
+            for _ in range(6):
+                out = r.predict(x)
+                assert out.shape == (256, 10)
+                assert float(out[0, 0]) == 3.0  # answered by the survivor
+                hedged = r.stats["hedged"]
+            assert hedged >= 1  # at least one attempt really did fail over
+            assert r.stats["failed"] == 0
+            # every frame the survivor decoded carried ALL 256 rows —
+            # nothing was replayed from a partially sent stream
+            assert ok.seen_rows and set(ok.seen_rows) == {256}
+            # the dead replica consumed bodies too (the stream really was
+            # half-spent from the client's perspective before each hedge)
+            assert dead.seen_rows and set(dead.seen_rows) == {256}
+
+
+def test_router_stale_connection_retry_rebuffers_binary_frame(lenet_stack):
+    """The stale-keep-alive half of the same contract: a replica
+    frontend restarted on the same port kills the router's cached
+    connection; the next predict must transparently reconnect and
+    resend the full frame (bit-identical answer, no caller-visible
+    error)."""
+    engine, _, frontend = lenet_stack
+    stub = StubBackend(tag=5.0)
+    fe = ServingFrontend(stub).start()
+    port = fe.port
+    r = Router([fe.url], fail_after=100)
+    x = _images(7, seed=32)
+    assert float(r.predict(x)[0, 0]) == 5.0  # conn cached per thread
+    fe.stop()
+    fe2 = ServingFrontend(stub, port=port).start()
+    out = r.predict(x)  # stale conn -> reconnect -> full frame resent
+    assert out.shape == (7, 10) and float(out[0, 0]) == 5.0
+    r.stop()
+    fe2.stop()
 
 
 def test_router_predict_bit_identical_through_real_engine(lenet_stack):

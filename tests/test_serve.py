@@ -795,6 +795,12 @@ def test_parse_serve_config_buckets_and_defaults():
     assert parse_serve_config([]).hedge is True
     cfg = parse_serve_config(["--num_devices", "2", "--no-hedge"])
     assert cfg.num_devices == 2 and cfg.hedge is False
+    # serve-roofline PR knobs: continuous batching on by default, the
+    # int8 lane strictly opt-in
+    assert parse_serve_config([]).continuous is True
+    assert parse_serve_config([]).int8 is False
+    cfg = parse_serve_config(["--no-continuous", "--int8"])
+    assert cfg.continuous is False and cfg.int8 is True
 
 
 def test_loadgen_reports_latency_percentiles(lenet_engine):
@@ -1202,3 +1208,221 @@ def test_bulk_deadline_expiry_counted_per_lane(lenet_engine):
         fut.result(timeout=60)
     b.close()
     assert b.stats["expired"] == 1 and b.stats["bulk_expired"] == 1
+
+
+# -- continuous batching (serve-roofline PR; SERVING.md) ----------------
+
+
+def test_continuous_admission_fills_bucket_slack(lenet_engine):
+    """The tentpole mechanism, deterministically: max_batch=3 against
+    buckets (1,4,8) means a formed 3-image batch dispatches the 4-bucket
+    with one pad row — the dispatch-time pass must fill it with the next
+    queued request instead of padding. 5 singles -> 2 batches (4 + 1),
+    with the 4th rider counted as a continuous admission, and every
+    answer bit-identical to the coalesced direct forward."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=3, max_wait_ms=50, max_queue=64,
+        autostart=False,
+    )
+    xs = [_images(1, seed=40 + i) for i in range(5)]
+    futs = [b.submit(x) for x in xs]
+    b.start()
+    outs = [f.result(timeout=60) for f in futs]
+    b.close()
+    assert b.stats["batches"] == 2
+    assert b.stats["largest_batch"] == 4  # 3 formed + 1 slack rider
+    assert b.stats["continuous_admitted"] >= 1
+    # order preserved, rows bit-exact: the first four rode one 4-bucket
+    # dispatch, the fifth its own bucket-1 program
+    full = lenet_engine.direct_forward(np.concatenate(xs[:4], axis=0))
+    for i in range(4):
+        assert np.array_equal(outs[i], full[i : i + 1])
+    assert np.array_equal(outs[4], lenet_engine.direct_forward(xs[4]))
+    # the dispatched PROGRAM never changed: no bucket recompiles
+    assert lenet_engine.compile_count == len(lenet_engine.buckets)
+
+
+def test_continuous_off_keeps_formation_batching(lenet_engine):
+    """--no-continuous escape hatch: the same load forms the same
+    batches the pre-slack batcher did (3 + 2), zero admissions."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=3, max_wait_ms=50, max_queue=64,
+        autostart=False, continuous=False,
+    )
+    futs = [b.submit(_images(1, seed=50 + i)) for i in range(5)]
+    b.start()
+    for f in futs:
+        f.result(timeout=60)
+    b.close()
+    assert b.stats["batches"] == 2
+    assert b.stats["largest_batch"] == 3
+    assert b.stats["continuous_admitted"] == 0
+
+
+def test_continuous_admits_bulk_into_slack_behind_interactive(lenet_engine):
+    """Bulk may ride leftover slack: 3 interactive singles fill the
+    formed batch; the queued bulk single fills the 4-bucket's pad row —
+    one dispatch serves all four, interactive rows first (lane order),
+    and the bulk accounting stays exact."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=3, max_wait_ms=50, max_queue=64,
+        autostart=False,
+    )
+    f_i = [b.submit(_images(1, seed=60 + i)) for i in range(3)]
+    f_b = b.submit(_images(1, seed=63), priority="bulk")
+    b.start()
+    for f in (*f_i, f_b):
+        f.result(timeout=60)
+    b.close()
+    assert b.stats["batches"] == 1
+    assert b.stats["largest_batch"] == 4
+    assert b.stats["continuous_admitted"] == 1
+    assert b.stats["bulk_requests"] == 1
+    assert b.stats["queued"] == {"interactive": 0, "bulk": 0}
+
+
+def test_continuous_slack_respects_never_split_and_fifo(lenet_engine):
+    """A lane head that does not fit the slack ends the pass (requests
+    are never split, FIFO is never reordered): formed [2], bucket 4,
+    slack 2 < the queued 3-image head -> two batches, zero slack
+    admissions."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    b = MicroBatcher(
+        lenet_engine, max_batch=2, max_wait_ms=50, max_queue=64,
+        autostart=False,
+    )
+    f1 = b.submit(_images(2, seed=70))
+    f2 = b.submit(_images(3, seed=71))  # 2+3 > the 4-bucket slack
+    b.start()
+    r1, r2 = f1.result(timeout=60), f2.result(timeout=60)
+    b.close()
+    assert b.stats["batches"] == 2
+    assert b.stats["continuous_admitted"] == 0
+    assert r1.shape == (2, 10) and r2.shape == (3, 10)
+
+
+# -- host staging arena (data/pipeline.StagingPool) ---------------------
+
+
+def test_staging_pool_reuses_buffers_by_shape():
+    """Pool unit semantics: same-shape acquires after a release hand
+    back the SAME buffer (identity), different shapes/dtypes key
+    separately, the retained set is capped, and the reuse counter
+    lands in the caller's registry."""
+    from pytorch_cifar_tpu.data.pipeline import StagingPool
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry()
+    pool = StagingPool(max_per_shape=1, registry=reg)
+    a = pool.acquire((4, 32, 32, 3), np.uint8)
+    assert a.shape == (4, 32, 32, 3) and a.dtype == np.uint8
+    pool.release(a)
+    b = pool.acquire((4, 32, 32, 3), np.uint8)
+    assert b is a  # the arena really is reuse, not realloc
+    c = pool.acquire((4, 32, 32, 3), np.uint8)
+    assert c is not a  # pool was empty again: fresh allocation
+    d = pool.acquire((8, 32, 32, 3), np.uint8)
+    assert d.shape[0] == 8  # shape-keyed: no cross-shape handouts
+    pool.release(b)
+    pool.release(c)  # over the cap: dropped to the allocator
+    e = pool.acquire((4, 32, 32, 3), np.uint8)
+    assert e is b
+    f = pool.acquire((4, 32, 32, 3), np.uint8)
+    assert f is not c  # c was not retained (max_per_shape=1)
+    assert reg.summary()["serve.staging_reuse"] == 2.0
+
+
+def test_engine_staging_reuse_counted_and_bit_identical():
+    """The engine's pad path allocates nothing after the first request
+    of a shape: repeat off-bucket predicts reuse the staging buffer
+    (serve.staging_reuse moves) and stay bit-identical to the direct
+    forward — a dirty reused buffer would corrupt the pad rows."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    reg = MetricsRegistry()
+    eng = InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4), compute_dtype=jnp.float32, registry=reg
+    )
+    x = _images(3, seed=80)
+    first = eng.predict(x)
+    assert np.array_equal(first, eng.direct_forward(x))
+    for i in range(3):
+        again = eng.predict(_images(3, seed=80))
+        assert np.array_equal(again, first)
+    assert reg.summary()["serve.staging_reuse"] >= 3.0
+
+
+# -- int8 bucket lane (serve-roofline PR; SERVING.md) -------------------
+
+
+def test_int8_engine_close_to_fp_and_internally_bit_stable():
+    """The quantized lane: same seed/buckets as the fp engine, logits
+    within the weight-only-int8 error envelope (it is NOT bit-identical
+    — that is why it is opt-in), padding still bit-identical WITHIN the
+    lane, compile count pinned, and the raw-tree swap contract intact
+    (weights_host -> swap_weights round-trips to the same bits, the
+    canary rollback path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    fp = InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4), compute_dtype=jnp.float32
+    )
+    reg = MetricsRegistry()
+    q = InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4), compute_dtype=jnp.float32, int8=True,
+        registry=reg,
+    )
+    x = _images(3, seed=90)
+    fp_out, q_out = fp.predict(x), q.predict(x)
+    # close (per-channel symmetric int8: ~0.4% observed) but not equal
+    err = float(np.max(np.abs(fp_out - q_out)))
+    scale = float(np.max(np.abs(fp_out)))
+    assert 0 < err <= 0.05 * scale + 1e-6, (err, scale)
+    # padding bit-identity holds INSIDE the lane (same contract as fp)
+    assert np.array_equal(q_out, q.direct_forward(x))
+    assert q.compile_count == 2
+    # raw-tree swap contract: weights_host returns FLOAT trees that
+    # swap back in to the identical served bits
+    params, stats = q.weights_host()
+    leaf = next(iter(jax.tree_util.tree_leaves(params)))
+    assert leaf.dtype != np.int8  # host view is the float originals
+    q.swap_weights(params, stats)
+    assert np.array_equal(q.predict(x), q_out)
+    # int8 lane counters moved (OBSERVABILITY.md rows)
+    s = reg.summary()
+    assert s["serve.int8_requests"] >= 2
+    assert s["serve.int8_images"] >= 6
+
+
+def test_int8_engine_rejects_mismatched_raw_trees():
+    """The swap gate still fires on a wrong-model tree — the comparison
+    is against RAW avals, not the quantized encoding."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    q = InferenceEngine.from_random(
+        "LeNet", buckets=(1,), compute_dtype=jnp.float32, int8=True
+    )
+    params, stats = q.weights_host()
+    bad = jax.tree_util.tree_map(
+        lambda v: v.astype(np.float64), params
+    )
+    with pytest.raises(ValueError, match="avals"):
+        q.swap_weights(bad, stats)
+

@@ -494,21 +494,27 @@ def router_drill(args, work: str) -> dict:
     # (it imported replica 0's AOT cache exports — SERVING.md)
     warm_compiles = int(healthz(replicas[1][1]).get("compiles", -1))
 
-    # bit-identity across the fleet: same payload to replica 0, replica
-    # 1, and the router must return byte-equal logits (the AOT-imported
-    # executables are probe-verified; this checks the whole wire too)
+    # bit-identity across the fleet AND across encodings: the same
+    # payload to replica 0, replica 1, and the router, over BOTH the
+    # JSON and the binary wire, must return byte-equal logits (the
+    # AOT-imported executables are probe-verified; this checks the
+    # whole wire both ways)
     probe = np.random.RandomState(7).randint(
         0, 256, size=(3, 32, 32, 3)
     ).astype(np.uint8)
     outs = [
-        HttpTarget(u).submit(probe).result()
+        HttpTarget(u, wire=w).submit(probe).result()
         for u in (replicas[0][1], replicas[1][1], router_url)
+        for w in ("json", "binary")
     ]
     bit_identical = all(np.array_equal(outs[0], o) for o in outs[1:])
 
     def load_phase(tag, duration_s, seed):
         rep = run_load(
-            HttpTarget(router_url),
+            # mixed fleet realism: each client thread alternates binary
+            # and JSON requests — bounded loss and bit-identity must
+            # hold regardless of encoding under the SIGKILL
+            HttpTarget(router_url, wire="mixed"),
             clients=4,
             requests_per_client=10**6,
             images_max=4,
@@ -583,7 +589,10 @@ def router_drill(args, work: str) -> dict:
         "reference_s": round(steady["elapsed_s"], 2),
         "recovery_s": round(kill_recovery_s, 2),
         "warm_replica_compiles": warm_compiles,
+        # bit-identity held across replicas AND both wire encodings;
+        # the load phases drove a mixed binary/JSON client fleet
         "bit_identical": bit_identical,
+        "wire": "mixed",
         "p99_steady_ms": round(steady["p99_ms"], 2),
         "p99_kill_ms": round(killed["p99_ms"], 2),
         "p99_post_ms": round(post["p99_ms"], 2),
