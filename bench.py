@@ -934,6 +934,149 @@ def run_serve_http(model: str, batch: int, steps: int, compute_dtype) -> dict:
     return report
 
 
+def run_serve_zoo(models, steps, compute_dtype) -> dict:
+    """The multi-tenant zoo serving contract (SERVING.md "Multi-tenant
+    zoo serving"): one ModelZooServer under a heavy-tailed per-model
+    mix. Three measurements ride one record:
+
+    - ``value`` = total img/s under the skewed mix with every tenant
+      resident, plus per-model img/s (each tenant's image counter over
+      the same wall clock — the heavy tail made visible);
+    - ``zoo_vs_dedicated`` = the hottest model's throughput through the
+      zoo (routing + LRU touch on the path) vs a DEDICATED single-model
+      engine+batcher at identical config — the multiplexing tax;
+    - ``eviction`` = placement-churn cost: a max_resident=1 zoo forced
+      to evict/re-admit on every alternation, reporting admission-
+      latency p50 and the re-admission compile/AOT counters (the
+      acceptance pin: re-admission is a verified cache import,
+      compiles == 0)."""
+    import tempfile
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve import (
+        InferenceEngine,
+        MicroBatcher,
+        ModelZooServer,
+        TenantSpec,
+    )
+    from pytorch_cifar_tpu.serve.loadgen import run_load, zipf_mix
+    from pytorch_cifar_tpu.serve.tenancy import load_cost_priors
+
+    cache = tempfile.mkdtemp(prefix="bench_zoo_aot_")
+    buckets = (1, 8)
+    priors = load_cost_priors()
+    mix = zipf_mix(list(models), priors=priors)
+
+    def specs():
+        return [
+            TenantSpec(m, buckets=buckets, seed=i)
+            for i, m in enumerate(models)
+        ]
+
+    requests = max(steps, 2)
+    registry = MetricsRegistry()
+    zoo = ModelZooServer(
+        specs(), compute_dtype=compute_dtype, registry=registry,
+        aot_cache_dir=cache,
+    )
+    hot = max(mix, key=mix.get)
+    try:
+        run_load(  # warmup: page executables under threads
+            zoo, clients=2, requests_per_client=2, seed=1, model_mix=mix
+        )
+        s0 = registry.summary()  # warmup excluded from per-model rates
+        report = run_load(
+            zoo, clients=8, requests_per_client=requests, images_max=8,
+            seed=0, model_mix=mix,
+        )
+        s1 = registry.summary()
+        zoo_single = run_load(
+            zoo, clients=8, requests_per_client=requests, images_max=8,
+            seed=0, model_mix={hot: 1.0},
+        )
+    finally:
+        zoo.close()
+    s = registry.summary()
+    elapsed = max(report["elapsed_s"], 1e-9)
+    report["per_model_img_per_sec"] = {
+        m: round(
+            (
+                s1.get(f"serve.tenant.{m}.images", 0.0)
+                - s0.get(f"serve.tenant.{m}.images", 0.0)
+            )
+            / elapsed,
+            3,
+        )
+        for m in models
+    }
+    report["mix"] = {m: round(w, 4) for m, w in mix.items()}
+
+    # the dedicated A/B: same model, same buckets/batcher config, no
+    # zoo in the path
+    ded_engine = InferenceEngine.from_random(
+        hot, seed=list(models).index(hot), buckets=buckets,
+        compute_dtype=compute_dtype,
+    )
+    ded_batcher = MicroBatcher(ded_engine, max_queue=1024)
+    try:
+        run_load(ded_batcher, clients=2, requests_per_client=2, seed=1)
+        dedicated = run_load(
+            ded_batcher, clients=8, requests_per_client=requests,
+            images_max=8, seed=0,
+        )
+    finally:
+        ded_batcher.close()
+    report["hot_model"] = hot
+    report["dedicated_img_per_sec"] = round(dedicated["img_per_sec"], 3)
+    report["zoo_vs_dedicated"] = round(
+        zoo_single["img_per_sec"] / max(dedicated["img_per_sec"], 1e-9), 4
+    )
+
+    # eviction/re-admission latency: max_resident=1 forces churn on
+    # every alternation; the AOT cache (already populated above) makes
+    # each re-admission an import, not a compile
+    churn_reg = MetricsRegistry()
+    churn = ModelZooServer(
+        specs(), max_resident=1, compute_dtype=compute_dtype,
+        registry=churn_reg, aot_cache_dir=cache, eager=False,
+    )
+    probe = np.random.RandomState(5).randint(
+        0, 256, size=(4, 32, 32, 3)
+    ).astype(np.uint8)
+    readmit_compiles = readmit_hits = 0
+    try:
+        two = list(models)[:2]
+        for _ in range(3):
+            for m in two:
+                churn.predict(probe, model=m)
+        # two[0] was just evicted by two[1]; touch it once more so the
+        # counters below describe a genuine RE-admission
+        churn.predict(probe, model=two[0])
+        h = churn.health()["tenants"][two[0]]
+        readmit_compiles = int(h["compiles"])
+        readmit_hits = int(h["aot_cache_hits"])
+        evictions = int(churn.stats["evictions"])
+    finally:
+        churn.close()
+    cs = churn_reg.summary()
+    report["eviction"] = {
+        "admission_ms_p50": round(
+            cs.get("serve.zoo.admission_ms.p50", 0.0), 3
+        ),
+        "evictions": evictions,
+        "readmit_compiles": readmit_compiles,
+        "readmit_aot_hits": readmit_hits,
+    }
+    report["obs"] = {
+        "queue_depth_max": s.get("serve.queue_depth.max", 0.0),
+        "latency_p95_ms": round(s.get("serve.latency_ms.p95", 0.0), 3),
+        "admissions": s.get("serve.zoo.admissions", 0.0),
+        "evictions": s.get("serve.zoo.evictions", 0.0),
+        "unknown_model": s.get("serve.zoo.unknown_model", 0.0),
+    }
+    return report
+
+
 def prior_round_value(metric: str):
     """OLDEST recorded BENCH_r{N}.json value for this exact metric.
 
@@ -1202,6 +1345,18 @@ def main() -> int:
         "p50/p95/p99 + img/s + http_vs_inproc in the single-line record",
     )
     parser.add_argument(
+        "--serve-zoo", action="store_true", dest="serve_zoo",
+        help="measure multi-tenant zoo serving (serve/tenancy.py, "
+        "SERVING.md 'Multi-tenant zoo serving'): per-model img/s under "
+        "a heavy-tailed --models mix, eviction/re-admission latency "
+        "p50, and the zoo-vs-dedicated throughput A/B in the "
+        "single-line record",
+    )
+    parser.add_argument(
+        "--models", default="LeNet,MobileNet",
+        help="comma-separated tenant list for --serve-zoo",
+    )
+    parser.add_argument(
         "--ckpt", action="store_true",
         help="measure the checkpoint layer: async-vs-sync save stall "
         "(trainer-thread blocked time, bit-identical files required) and "
@@ -1240,6 +1395,7 @@ def main() -> int:
         or args.step
         or args.serve
         or args.serve_http
+        or args.serve_zoo
         or args.ckpt
         or args.canary
         or args.config is not None
@@ -1332,6 +1488,31 @@ def main() -> int:
             obs=report["obs"],
         )
         name = f"serve_http_{args.model}_b{report['max_batch']}"
+    elif args.serve_zoo:
+        zoo_models = [m.strip() for m in args.models.split(",") if m.strip()]
+        report = run_serve_zoo(zoo_models, args.steps, compute_dtype)
+        value = report["img_per_sec"]
+        # TOTAL zoo throughput under the heavy-tailed mix; the per-model
+        # split and the placement-churn numbers ride along
+        unit = "images/sec"
+        extra = {
+            k: round(report[k], 3)
+            for k in ("p50_ms", "p95_ms", "p99_ms", "mean_ms")
+        }
+        extra.update(
+            requests=report["requests"],
+            failed=report["failed"],
+            rejected=report["rejected"],
+            per_model=report["per_model"],
+            per_model_img_per_sec=report["per_model_img_per_sec"],
+            mix=report["mix"],
+            hot_model=report["hot_model"],
+            dedicated_img_per_sec=report["dedicated_img_per_sec"],
+            zoo_vs_dedicated=report["zoo_vs_dedicated"],
+            eviction=report["eviction"],
+            obs=report["obs"],
+        )
+        name = f"serve_zoo_{len(zoo_models)}tenants"
     elif args.config is not None:
         models, batch = CONFIGS[args.config]
         batch = min(batch, args.batch) if platform == "cpu" else batch

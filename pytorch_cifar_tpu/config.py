@@ -215,6 +215,23 @@ class ServeConfig:
     ckpt: str = "./checkpoint"  # Trainer output dir, .msgpack, or ckpt.pth
     num_classes: int = 10
 
+    # multi-tenant zoo serving (SERVING.md "Multi-tenant zoo serving"):
+    # a comma-separated tenant list "Name[=ckpt_dir],Name2[=dir2],..."
+    # turns this process into a ModelZooServer hosting every named
+    # MODEL_REGISTRY model — one engine+batcher pair per resident model,
+    # cost-prior-seeded LRU placement under max_resident / zoo_memory_mb,
+    # model-id routing on /predict (JSON "model" field, wire-v2 frame
+    # field; no model = the FIRST listed tenant). A tenant without
+    # "=ckpt_dir" loads <--ckpt>/<Name> when that dir exists, else
+    # serves deterministic random-init weights at --seed (bench/drill
+    # tenants). Empty = the single-model engine exactly as before.
+    models: str = ""
+    # resident-set bounds: tenant count (0 = all tenants resident) and
+    # estimated weight-bytes budget in MiB (0 = unbounded); eviction is
+    # a drain + drop, re-admission a verified AOT-cache import
+    max_resident: int = 0
+    zoo_memory_mb: float = 0.0
+
     # engine: one AOT-compiled forward per bucket; partial batches pad up
     # to the nearest bucket, so after warmup NO request shape compiles
     buckets: Tuple[int, ...] = (1, 8, 32, 128)

@@ -80,6 +80,12 @@ HOT_SEEDS: Sequence[Tuple[str, frozenset]] = (
     # out of host-sync scope
     ("serve/batcher.py",
      frozenset({"_worker", "_admit_slack_locked", "_assemble"})),
+    # the zoo's request path: routing + admission + the eviction drain
+    # all sit in front of every tenant's device call — seeded explicitly
+    # so a tenancy refactor cannot silently drop them out of host-sync
+    # scope (same rationale as the batcher worker seeds above)
+    ("serve/tenancy.py",
+     frozenset({"submit", "predict", "_ensure_resident", "_evict"})),
 )
 
 _THREAD_CTORS = ("threading.Thread", "Thread")

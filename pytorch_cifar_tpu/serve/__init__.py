@@ -33,6 +33,15 @@ becomes a long-lived prediction service:
   frontend — ``serve.py --http_port`` runs one replica,
   ``tools/router_run.py`` runs the fleet (SERVING.md "HTTP frontend &
   router").
+- :mod:`~pytorch_cifar_tpu.serve.tenancy` is multi-tenant zoo serving:
+  a :class:`~pytorch_cifar_tpu.serve.tenancy.ModelZooServer` hosts N
+  registry models in one process — one engine + micro-batcher pair per
+  resident model under a shared memory budget, cost-prior-seeded LRU
+  placement/eviction (evict = drain + drop programs; re-admit = a
+  verified AOT-cache import, zero compiles, bit-identical), per-model
+  admission queues/SLOs/hot-reload/canary, and model-id routing through
+  the frontend (JSON ``model`` field / wire-v2 frame field) and the
+  router (SERVING.md "Multi-tenant zoo serving").
 - :mod:`~pytorch_cifar_tpu.serve.canary` closes the train→serve loop:
   a :class:`~pytorch_cifar_tpu.serve.canary.PromotionController` vets
   every checkpoint a ``--publish staging`` trainer commits — golden-batch
@@ -67,4 +76,9 @@ from pytorch_cifar_tpu.serve.frontend import (  # noqa: F401
 )
 from pytorch_cifar_tpu.serve.reload import CheckpointWatcher  # noqa: F401
 from pytorch_cifar_tpu.serve.router import Router  # noqa: F401
+from pytorch_cifar_tpu.serve.tenancy import (  # noqa: F401
+    ModelZooServer,
+    TenantSpec,
+    UnknownModel,
+)
 from pytorch_cifar_tpu.serve import wire  # noqa: F401

@@ -144,6 +144,45 @@ class GoldenSet:
         return cls(x[:limit], y[:limit])
 
     @classmethod
+    def labeled_eval(
+        cls,
+        data_dir: str = "./data",
+        *,
+        limit: int = 256,
+        seed: int = 0,
+        download: bool = False,
+    ) -> "GoldenSet":
+        """The REAL labeled eval split — the same CIFAR-10 test set
+        ``tools/accuracy_run.py`` measures the north-star accuracy on —
+        as golden data, so a :class:`CanaryBudget`'s accuracy gate
+        judges exact labeled accuracy rather than argmax-flip fraction
+        (the ROADMAP standing item: per-tenant canary budgets gating on
+        real accuracy). Falls back LOUDLY to the deterministic
+        synthetic eval split when the archive is absent and
+        ``download`` is False (zero-egress build containers: the gate
+        semantics are identical, only the labels' provenance differs).
+        Per-tenant zoo canaries default to this
+        (:meth:`~pytorch_cifar_tpu.serve.tenancy.ModelZooServer.enable_canary`).
+        """
+        from pytorch_cifar_tpu.data.cifar10 import (
+            _find_dataset,
+            load_cifar10,
+            synthetic_cifar10,
+        )
+
+        if _find_dataset(data_dir) is None and not download:
+            log.warning(
+                "labeled_eval: CIFAR-10 not found under %r (download "
+                "disabled); golden accuracy gates run on the SYNTHETIC "
+                "eval split — same exact-count semantics, synthetic "
+                "labels", data_dir,
+            )
+            _, _, x, y = synthetic_cifar10(seed=seed)
+        else:
+            _, _, x, y = load_cifar10(data_dir, synthetic_ok=True)
+        return cls(x[:limit], y[:limit])
+
+    @classmethod
     def random(
         cls, n: int = 64, seed: int = 0, image_shape=(32, 32, 3)
     ) -> "GoldenSet":

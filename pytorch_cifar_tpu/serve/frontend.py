@@ -81,6 +81,7 @@ from pytorch_cifar_tpu.serve.batcher import (
     DeadlineExceeded,
     QueueFull,
 )
+from pytorch_cifar_tpu.serve.tenancy import UnknownModel
 
 log = logging.getLogger(__name__)
 
@@ -92,11 +93,16 @@ MAX_IMAGES_PER_REQUEST = 4096
 
 def decode_predict_request(
     body: bytes, image_shape: Tuple[int, int, int]
-) -> Tuple[np.ndarray, Optional[float], str, str]:
+) -> Tuple[np.ndarray, Optional[float], str, str, Optional[str]]:
     """Parse a ``/predict`` JSON body into ``(images, deadline_ms,
-    priority, encoding)``. Raises ``ValueError`` on ANY malformed input —
-    the handler maps that to 400 with the message as the response body,
-    so a client sees WHY its request was rejected."""
+    priority, encoding, model)``. ``model`` (optional) is the tenant id
+    of a multi-model zoo backend (SERVING.md "Multi-tenant zoo
+    serving"); None routes to the server's default model. Raises
+    ``ValueError`` on ANY malformed input — the handler maps that to
+    400 with the message as the response body, so a client sees WHY its
+    request was rejected (an unknown-but-well-formed model name is NOT
+    malformed: the backend raises UnknownModel and the handler answers
+    404)."""
     try:
         req = json.loads(body.decode("utf-8"))
     except (UnicodeDecodeError, ValueError) as e:
@@ -167,7 +173,10 @@ def decode_predict_request(
     encoding = req.get("encoding", "json")
     if encoding not in ("json", "b64"):
         raise ValueError("'encoding' must be 'json' or 'b64'")
-    return x, deadline_ms, priority, encoding
+    model = req.get("model")
+    if model is not None and (not isinstance(model, str) or not model):
+        raise ValueError("'model' must be a non-empty string when present")
+    return x, deadline_ms, priority, encoding, model
 
 
 def encode_predict_response(
@@ -442,7 +451,7 @@ class _Handler(BaseHTTPRequestHandler):
             t_dec = time.perf_counter()
             try:
                 if binary:
-                    x, deadline_ms, priority, json_resp = (
+                    x, deadline_ms, priority, json_resp, model = (
                         wire.decode_request(
                             body, fe.image_shape, MAX_IMAGES_PER_REQUEST
                         )
@@ -450,17 +459,40 @@ class _Handler(BaseHTTPRequestHandler):
                     encoding = "json" if json_resp else "binary"
                     fe.c_wire_requests.inc()
                 else:
-                    x, deadline_ms, priority, encoding = (
+                    x, deadline_ms, priority, encoding, model = (
                         decode_predict_request(body, fe.image_shape)
                     )
             except (wire.WireError, ValueError) as e:
                 self._error(400, str(e))
                 return
             fe.h_wire_decode.observe((time.perf_counter() - t_dec) * 1e3)
+            # model routing (SERVING.md "Multi-tenant zoo serving"): a
+            # routing backend (zoo server, router) takes the id as a
+            # kwarg; a single-model replica accepts its OWN model name
+            # and 404s any other — unknown model is a routing miss, not
+            # a malformed request
+            if model is not None and not fe.backend_routes_models:
+                if model != fe.served_model:
+                    self._error(
+                        404,
+                        f"model {model!r} is not served here "
+                        f"(this replica serves {fe.served_model!r})",
+                    )
+                    return
+                model = None  # satisfied: call the single-model surface
             try:
-                logits = fe.backend.predict(
-                    x, deadline_ms=deadline_ms, priority=priority
-                )
+                if model is not None:
+                    logits = fe.backend.predict(
+                        x, deadline_ms=deadline_ms, priority=priority,
+                        model=model,
+                    )
+                else:
+                    logits = fe.backend.predict(
+                        x, deadline_ms=deadline_ms, priority=priority
+                    )
+            except UnknownModel as e:
+                self._error(404, str(e))
+                return
             except QueueFull as e:
                 self._error(429, str(e))
                 return
@@ -528,6 +560,24 @@ class ServingFrontend:
         # format exists to shrink)
         self.c_wire_requests = self.registry.counter("serve.wire_requests")
         self.h_wire_decode = self.registry.histogram("serve.wire_decode_ms")
+        # model routing: a zoo server / router declares routing support
+        # and takes the request's model id as a predict kwarg; for a
+        # single-model backend, resolve the one name it serves (walking
+        # wrapper backends like ShadowBackend) so a request naming it
+        # explicitly still succeeds and anything else is a clean 404
+        self.backend_routes_models = bool(
+            getattr(backend, "supports_model_routing", False)
+        )
+        self.served_model = None
+        b = backend
+        for _ in range(4):  # backend wrappers nest at most a few deep
+            eng = getattr(b, "engine", None)
+            if eng is not None and hasattr(eng, "model_name"):
+                self.served_model = eng.model_name
+                break
+            b = getattr(b, "backend", None)
+            if b is None:
+                break
         self._server = _Server((host, int(port)), self)
         self.host, self.port = self._server.server_address[:2]
         # accept-loop thread handle: shared with stop() (lock per

@@ -34,6 +34,15 @@ mixed fleet of both (SERVING.md "Binary wire format").
 ``priority="bulk"`` (per-client deterministic rng), exercising the
 batcher's lanes and the router's priority-aware admission under one
 closed loop.
+
+**Heavy-tailed multi-model load** (SERVING.md "Multi-tenant zoo
+serving"): ``model_mix={name: weight, ...}`` makes each request name a
+model drawn from that distribution (per-client deterministic rng) —
+:func:`zipf_mix` builds the production-shaped heavy tail from the zoo's
+model list, optionally ordered by the zoo sweep's throughput priors.
+The id rides the JSON ``model`` field or the wire-v2 frame field
+(``HttpTarget``) or the zoo server's ``submit(model=)`` surface; the
+report grows a ``per_model`` request-count block.
 """
 
 from __future__ import annotations
@@ -140,11 +149,14 @@ class HttpTarget:
         images: np.ndarray,
         deadline_ms: Optional[float] = None,
         priority: str = "interactive",
+        model: Optional[str] = None,
     ) -> _Resolved:
         """One synchronous ``POST /predict``; returns a resolved future
         of the fp32 logits (b64-packed JSON or a raw binary frame on the
         wire, per ``wire``: bit-identical to the server's array either
-        way)."""
+        way). ``model`` names a zoo tenant (JSON ``model`` field /
+        wire-v2 frame field); an unhosted model's 404 raises
+        :class:`~pytorch_cifar_tpu.serve.tenancy.UnknownModel`."""
         from pytorch_cifar_tpu.serve import wire as wire_mod
         from pytorch_cifar_tpu.serve.frontend import decode_logits
 
@@ -162,6 +174,7 @@ class HttpTarget:
                 x,
                 deadline_ms=float(deadline_ms) if deadline_ms else None,
                 priority=priority,
+                model=model,
             )
             ctype = wire_mod.CONTENT_TYPE
         else:
@@ -173,6 +186,8 @@ class HttpTarget:
             }
             if deadline_ms:
                 req["deadline_ms"] = float(deadline_ms)
+            if model is not None:
+                req["model"] = str(model)
             body = json.dumps(req).encode("utf-8")
             ctype = "application/json"
         for attempt in (0, 1):
@@ -206,6 +221,10 @@ class HttpTarget:
             err = json.loads(payload).get("error", "")
         except ValueError:
             err = payload[:200].decode("utf-8", "replace")
+        if status == 404:
+            from pytorch_cifar_tpu.serve.tenancy import UnknownModel
+
+            raise UnknownModel(f"{self.url}: {err}")
         if status == 429:
             raise QueueFull(f"{self.url}: {err}")
         if status == 504:
@@ -217,6 +236,23 @@ class HttpTarget:
         if conn is not None:
             conn.close()
             self._local.conn = None
+
+
+def zipf_mix(models, s: float = 1.2, priors=None) -> dict:
+    """Heavy-tailed per-model traffic weights: weight(rank) = 1/rank^s,
+    the classic production shape (a few hot models, a long cold tail).
+    With ``priors`` ({model: img/s} — the zoo sweep's cost priors), rank
+    order is cheapest-first so the HOT models are the cheap ones (the
+    realistic case: the expensive tail still forces placement churn);
+    without priors the given order is the rank order."""
+    models = list(models)
+    if priors:
+        models.sort(key=lambda m: -float(priors.get(m, 0.0)))
+    weights = {
+        m: 1.0 / float(rank + 1) ** s for rank, m in enumerate(models)
+    }
+    total = sum(weights.values())
+    return {m: w / total for m, w in weights.items()}
 
 
 def percentile_ms(latencies_ms, pct: float) -> float:
@@ -241,6 +277,7 @@ def run_load(
     duration_s: Optional[float] = None,
     hedge: bool = True,
     bulk_fraction: float = 0.0,
+    model_mix: Optional[dict] = None,
 ) -> dict:
     """Drive ``batcher`` with ``clients`` synchronous synthetic clients.
 
@@ -253,9 +290,15 @@ def run_load(
     ``bulk_fraction``: that share of requests carries
     ``priority="bulk"`` (deterministic per-client rng; 0.0 keeps the
     all-interactive protocol every earlier round reported).
+    ``model_mix``: {model: weight} — each request names a model drawn
+    from this distribution (:func:`zipf_mix` builds the heavy tail);
+    the target must take a ``model`` kwarg on ``submit`` (an
+    :class:`HttpTarget` or a
+    :class:`~pytorch_cifar_tpu.serve.tenancy.ModelZooServer`), and the
+    report grows a ``per_model`` request-count block.
     ``batcher`` is anything with the submit surface — a
-    :class:`~pytorch_cifar_tpu.serve.batcher.MicroBatcher` or an
-    :class:`HttpTarget` (the full network path).
+    :class:`~pytorch_cifar_tpu.serve.batcher.MicroBatcher`, an
+    :class:`HttpTarget` (the full network path), or a zoo server.
 
     Returns the latency/throughput report the CLIs publish:
     ``img_per_sec``, ``request_per_sec``, ``p50_ms``/``p95_ms``/``p99_ms``,
@@ -267,17 +310,25 @@ def run_load(
     counts = {
         "images": 0, "rejected": 0, "hedged": 0, "failed": 0, "bulk": 0,
     }
+    per_model: dict = {}
     lock = threading.Lock()
     stop_at = None
+    # the per-model draw table (cumulative weights, deterministic rng)
+    mix_names = mix_cum = None
+    if model_mix:
+        mix_names = list(model_mix)
+        w = np.asarray([float(model_mix[m]) for m in mix_names])
+        mix_cum = np.cumsum(w / w.sum())
     # hedges ride the serving registry (when the batcher carries one) so
     # the Prometheus dump / exporter see retry pressure, not just the CLI
     obs = getattr(batcher, "obs", None)
     c_hedged = obs.counter("serve.hedged") if obs is not None else None
 
-    def submit_with_backoff(x, priority):
+    def submit_with_backoff(x, priority, model):
+        kw = {} if model is None else {"model": model}
         while True:
             try:
-                return batcher.submit(x, priority=priority)
+                return batcher.submit(x, priority=priority, **kw)
             except QueueFull:
                 # admission control said back off; the retry delay is
                 # part of the client-observed latency (t0 stays)
@@ -300,9 +351,14 @@ def run_load(
             if priority == "bulk":
                 with lock:
                     counts["bulk"] += 1
+            model = None
+            if mix_names is not None:
+                model = mix_names[
+                    int(np.searchsorted(mix_cum, rs.uniform()))
+                ]
             t0 = time.perf_counter()
             try:
-                submit_with_backoff(x, priority).result()
+                submit_with_backoff(x, priority, model).result()
             except DeadlineExceeded:
                 if not hedge:
                     with lock:
@@ -316,7 +372,7 @@ def run_load(
                 if c_hedged is not None:
                     c_hedged.inc()
                 try:
-                    submit_with_backoff(x, priority).result()
+                    submit_with_backoff(x, priority, model).result()
                 except (DeadlineExceeded, BatcherClosed):
                     with lock:
                         counts["failed"] += 1
@@ -329,6 +385,8 @@ def run_load(
             with lock:
                 latencies_ms.append(dt_ms)
                 counts["images"] += n
+                if model is not None:
+                    per_model[model] = per_model.get(model, 0) + 1
 
     threads = [
         threading.Thread(target=client, args=(i,), name=f"loadgen-{i}")
@@ -343,6 +401,11 @@ def run_load(
         t.join()
     elapsed = time.perf_counter() - t_start
 
+    out_per_model = (
+        {"per_model": {m: per_model.get(m, 0) for m in mix_names}}
+        if mix_names is not None
+        else {}
+    )
     return {
         "clients": clients,
         "requests": len(latencies_ms),
@@ -351,6 +414,7 @@ def run_load(
         "hedged": counts["hedged"],
         "failed": counts["failed"],
         "bulk_requests": counts["bulk"],
+        **out_per_model,
         "elapsed_s": round(elapsed, 4),
         "img_per_sec": counts["images"] / max(elapsed, 1e-9),
         "request_per_sec": len(latencies_ms) / max(elapsed, 1e-9),

@@ -63,6 +63,7 @@ from pytorch_cifar_tpu.serve.batcher import (
     DeadlineExceeded,
     QueueFull,
 )
+from pytorch_cifar_tpu.serve.tenancy import UnknownModel
 
 log = logging.getLogger(__name__)
 
@@ -184,7 +185,20 @@ class Router:
     backend protocol: ``predict`` raises the batcher exception types so
     the frontend's status-code mapping is identical for one replica or
     fifty. ``start()`` launches the health-probe thread; ``stop()``
-    joins it."""
+    joins it.
+
+    **Model-aware dispatch** (SERVING.md "Multi-tenant zoo serving"):
+    ``predict(..., model=...)`` rides the wire-v2 frame to the replica.
+    Replica selection filters on each replica's last probed ``/healthz``
+    ``models`` list when one is present (a zoo replica advertises its
+    tenants), so a model is dispatched only to replicas that host it; a
+    replica answering 404 anyway (stale health, mid-reconfig) raises
+    :class:`~pytorch_cifar_tpu.serve.tenancy.UnknownModel` — the
+    frontend's 404, deterministic, never hedged (every replica of a
+    homogeneous fleet would answer the same)."""
+
+    # the frontend passes request model ids through to this backend
+    supports_model_routing = True
 
     def __init__(
         self,
@@ -231,6 +245,7 @@ class Router:
         # controller — a lock+append into its bounded queue, never a
         # canary compute, never an error on the client path
         self._shadow = None
+        self._shadow_model = None  # tee only this model's traffic
         self._g_healthy.set(len(self.replicas))
 
     def attach_shadow(self, controller) -> None:
@@ -238,24 +253,54 @@ class Router:
         :class:`~pytorch_cifar_tpu.serve.canary.PromotionController`:
         ``offer(images, incumbent_logits, priority=...)`` is called with
         the request AND the incumbent's answer (no second incumbent
-        pass), off the client response path. ``None`` detaches."""
+        pass), off the client response path. ``None`` detaches. On a
+        multi-model fleet only requests for the controller's OWN model
+        are offered (a per-tenant canary must never vet another
+        tenant's traffic)."""
         with self._lock:
             self._shadow = controller
+            self._shadow_model = getattr(
+                getattr(controller, "engine", None), "model_name", None
+            )
 
     # -- replica selection + state transitions -------------------------
 
-    def _pick_locked(self, exclude=()) -> Optional[Replica]:
+    def _pick_locked(self, exclude=(), model=None) -> Optional[Replica]:
         """Healthy replica with the fewest in-flight requests;
-        round-robin breaks ties so equal-load replicas share work."""
+        round-robin breaks ties so equal-load replicas share work. With
+        ``model``, replicas whose last probed health advertises a
+        ``models`` list that does NOT contain it are skipped (zoo
+        fleets may shard tenants across replicas); replicas with no
+        model list yet (pre-first-probe) stay candidates — a wrong
+        guess costs one 404-classified dispatch, not an outage."""
         candidates = [
             r for r in self.replicas if r.healthy and r not in exclude
         ]
+        if model is not None:
+            candidates = [
+                r for r in candidates if self._hosts(r, model)
+            ]
         if not candidates:
             return None
         low = min(r.in_flight for r in candidates)
         tied = [r for r in candidates if r.in_flight == low]
         self._rr += 1
         return tied[self._rr % len(tied)]
+
+    @staticmethod
+    def _hosts(replica: Replica, model: str) -> bool:
+        """Does this replica host ``model``, per its last probed health?
+        Zoo replicas advertise a ``models`` list; single-model replicas
+        a scalar ``model``; a replica never probed yet stays a
+        candidate (a wrong guess costs one 404-classified dispatch)."""
+        h = replica.last_health
+        if not h:
+            return True
+        models = h.get("models")
+        if models:
+            return model in models
+        served = h.get("model")
+        return served is None or served == model
 
     def _mark_failure(self, replica: Replica, why: str) -> None:
         self._c_replica_errors.inc()
@@ -327,6 +372,11 @@ class Router:
             self._mark_success(replica)
             return logits
         err = resp.get("error", f"http {status}")
+        if status == 404:
+            # routing miss, not replica damage: the model is not hosted
+            # there (or anywhere, for a homogeneous fleet) — surface the
+            # frontend's 404 deterministically, never hedge or evict
+            raise UnknownModel(f"{replica.url}: {err}")
         if status == 429:
             # admission control, not replica damage: no failure mark
             raise QueueFull(f"{replica.url}: {err}")
@@ -342,19 +392,25 @@ class Router:
         images: np.ndarray,
         deadline_ms: Optional[float] = None,
         priority: str = "interactive",
+        model: Optional[str] = None,
     ) -> np.ndarray:
         """Route one request (module docstring: least-loaded dispatch,
         hedge-once on deadline/replica failure, priority-aware 429
-        handling). Raises the batcher exception types so callers — the
-        frontend above all — need no router-specific error handling."""
+        handling, model-aware candidate filtering). Raises the batcher
+        exception types (plus UnknownModel for an unhosted model id) so
+        callers — the frontend above all — need no router-specific
+        error handling."""
         x = np.ascontiguousarray(np.asarray(images, dtype=np.uint8))
         # ONE buffered binary frame (serve/wire.py) per request: every
         # attempt — first dispatch, stale-connection retry, cross-replica
-        # hedge — resends these exact bytes in full
+        # hedge — resends these exact bytes in full (a model id rides
+        # the v2 frame field; no model = the v1 frame, byte-identical
+        # to the pre-zoo router)
         body = wire.encode_request(
             x,
             deadline_ms=float(deadline_ms) if deadline_ms else None,
             priority=priority,
+            model=model,
         )
         # per-attempt HTTP timeout: the deadline bounds queue time on the
         # replica; the wire timeout must outlive deadline + service time,
@@ -369,7 +425,7 @@ class Router:
         last_exc: Optional[Exception] = None
         for attempt in range(attempts):
             with self._lock:
-                replica = self._pick_locked(exclude=attempted)
+                replica = self._pick_locked(exclude=attempted, model=model)
             if replica is None:
                 break  # nobody (left) to try
             attempted.append(replica)
@@ -379,6 +435,9 @@ class Router:
                 self._h_latency.observe((time.perf_counter() - t0) * 1e3)
                 with self._lock:
                     shadow = self._shadow
+                    shadow_model = self._shadow_model
+                if shadow is not None and model not in (None, shadow_model):
+                    shadow = None  # another tenant's traffic: never teed
                 if shadow is not None:
                     # fire-and-forget: offer() enqueues (or drops) and
                     # never raises — the client's bits and deadline are
@@ -405,6 +464,17 @@ class Router:
         if isinstance(last_exc, DeadlineExceeded):
             raise last_exc
         if last_exc is None:
+            if model is not None:
+                with self._lock:
+                    healthy = [r for r in self.replicas if r.healthy]
+                if healthy and not any(
+                    self._hosts(r, model) for r in healthy
+                ):
+                    # healthy fleet, nobody hosts the model: the
+                    # deterministic 404, not an availability error
+                    raise UnknownModel(
+                        f"router: no replica hosts model {model!r}"
+                    )
             raise BatcherClosed("router: no healthy replica")
         # replica death on every attempt: unavailable, retry elsewhere
         raise BatcherClosed(f"router: {last_exc}")

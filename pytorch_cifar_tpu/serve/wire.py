@@ -19,15 +19,19 @@ this module is the single implementation both sides share):
 
     offset  size  field
     0       4     magic ``b"PCTW"``
-    4       1     version (currently 1)
+    4       1     version (1 or 2; see below)
     5       1     frame type: 1 = predict request, 2 = logits response
     6       1     dtype code: 1 = uint8 (requests), 2 = float32 (responses)
     7       1     flags (requests: bit0 deadline field present, bit1 bulk
-                  priority, bit2 respond in JSON; responses: none)
+                  priority, bit2 respond in JSON, bit3 model-id field
+                  present [version 2 only]; responses: none)
     8       16    4 x uint32 LE dims — requests: [n, h, w, c];
                   responses: [n, num_classes, engine_version, 0]
     24      8     float64 LE ``deadline_ms`` — present ONLY when flag
                   bit0 is set (requests only)
+    ...     1+L   model id — present ONLY when flag bit3 is set (version
+                  2 requests only): one uint8 length L, then L bytes of
+                  UTF-8 model name (a ``models.MODEL_REGISTRY`` key)
     ...           payload: raw C-order bytes, exactly prod(dims) elements
 
 Version/compat policy: the version byte covers the whole layout — any
@@ -36,6 +40,24 @@ frames from a version it does not speak with a 400 (clients fall back to
 JSON, which every server version accepts). Reserved flag bits MUST be
 zero; a frame with unknown bits set is rejected rather than half-read,
 so a future flag can change the layout behind it safely.
+
+Version 2 (multi-tenant zoo serving, SERVING.md "Multi-tenant zoo
+serving") adds exactly one thing: the optional model-id field selecting
+a tenant of a :class:`~pytorch_cifar_tpu.serve.tenancy.ModelZooServer`.
+Compat, per the policy above:
+
+- **v1 frames keep decoding forever** and route to the server's DEFAULT
+  model — a pre-zoo client against a zoo fleet keeps working unchanged;
+  :func:`encode_request` still emits v1 when no model is named, so the
+  v1 path stays continuously exercised.
+- flag bit3 is RESERVED in v1 (a v1 frame with it set is a 400, as it
+  always was); only v2 frames may carry the field.
+- a well-formed frame naming a model the server does not host is **404**
+  (JSON error body), not 400 — the frame was valid, the tenant is
+  absent; malformed frames (truncated model field, zero-length name,
+  undecodable UTF-8) stay 400s.
+- response frames are unchanged by v2 and are still emitted at v1;
+  decoders accept either version byte.
 
 Every malformed-input class raises :class:`WireError` with a message
 naming exactly what was wrong — the frontend maps it to a 400 with a
@@ -51,7 +73,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 MAGIC = b"PCTW"
-VERSION = 1
+VERSION_V1 = 1
+VERSION = 2  # current: v1 + the optional model-id field (module docstring)
 FRAME_PREDICT = 1
 FRAME_LOGITS = 2
 DTYPE_UINT8 = 1
@@ -59,7 +82,12 @@ DTYPE_FLOAT32 = 2
 FLAG_DEADLINE = 0x01
 FLAG_BULK = 0x02
 FLAG_JSON_RESPONSE = 0x04
-_KNOWN_FLAGS = FLAG_DEADLINE | FLAG_BULK | FLAG_JSON_RESPONSE
+FLAG_MODEL = 0x08  # version 2 only; reserved (-> 400) in version 1
+_KNOWN_FLAGS = {
+    VERSION_V1: FLAG_DEADLINE | FLAG_BULK | FLAG_JSON_RESPONSE,
+    VERSION: FLAG_DEADLINE | FLAG_BULK | FLAG_JSON_RESPONSE | FLAG_MODEL,
+}
+MAX_MODEL_NAME_BYTES = 255  # one uint8 length prefix
 
 # magic, version, frame type, dtype code, flags, 4 x uint32 dims
 _HEADER = struct.Struct("<4sBBBB4I")
@@ -81,6 +109,7 @@ def max_request_bytes(image_shape: Tuple[int, int, int], max_images: int) -> int
     return (
         HEADER_SIZE
         + _DEADLINE.size
+        + 1 + MAX_MODEL_NAME_BYTES  # the v2 model-id field at its largest
         + int(max_images) * int(np.prod(image_shape))
     )
 
@@ -90,8 +119,13 @@ def encode_request(
     deadline_ms: Optional[float] = None,
     priority: str = "interactive",
     json_response: bool = False,
+    model: Optional[str] = None,
 ) -> bytes:
-    """One predict-request frame for a uint8 NHWC batch."""
+    """One predict-request frame for a uint8 NHWC batch. With no
+    ``model`` the frame is emitted at VERSION 1 (byte-identical to the
+    pre-zoo encoder — maximum compat, and the v1 decode path stays
+    continuously exercised); naming a model emits a version-2 frame
+    carrying the model-id field."""
     x = np.ascontiguousarray(np.asarray(images, dtype=np.uint8))
     if x.ndim != 4:
         raise ValueError(f"images must be (n, h, w, c), got {x.shape}")
@@ -102,12 +136,25 @@ def encode_request(
         flags |= FLAG_BULK
     if json_response:
         flags |= FLAG_JSON_RESPONSE
+    model_bytes = b""
+    version = VERSION_V1
+    if model is not None:
+        model_bytes = str(model).encode("utf-8")
+        if not 0 < len(model_bytes) <= MAX_MODEL_NAME_BYTES:
+            raise ValueError(
+                f"model name must be 1..{MAX_MODEL_NAME_BYTES} UTF-8 "
+                f"bytes, got {len(model_bytes)}"
+            )
+        flags |= FLAG_MODEL
+        version = VERSION
     header = _HEADER.pack(
-        MAGIC, VERSION, FRAME_PREDICT, DTYPE_UINT8, flags, *x.shape
+        MAGIC, version, FRAME_PREDICT, DTYPE_UINT8, flags, *x.shape
     )
     parts = [header]
     if deadline_ms is not None:
         parts.append(_DEADLINE.pack(float(deadline_ms)))
+    if model is not None:
+        parts.append(bytes([len(model_bytes)]) + model_bytes)
     parts.append(x.data if x.flags.c_contiguous else x.tobytes())
     return b"".join(parts)
 
@@ -123,10 +170,10 @@ def _header(body: bytes, want_frame: int, want_dtype: int):
     )
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r} (expected {MAGIC!r})")
-    if version != VERSION:
+    if version not in _KNOWN_FLAGS:
         raise WireError(
             f"unsupported wire version {version} (this side speaks "
-            f"{VERSION}; fall back to the JSON encoding)"
+            f"{sorted(_KNOWN_FLAGS)}; fall back to the JSON encoding)"
         )
     if frame != want_frame:
         raise WireError(f"unexpected frame type {frame} (expected {want_frame})")
@@ -134,22 +181,25 @@ def _header(body: bytes, want_frame: int, want_dtype: int):
         raise WireError(
             f"unsupported dtype code {dtype} (expected {want_dtype})"
         )
-    return flags, (d0, d1, d2, d3)
+    return version, flags, (d0, d1, d2, d3)
 
 
 def decode_request(
     body: bytes,
     image_shape: Tuple[int, int, int],
     max_images: int,
-) -> Tuple[np.ndarray, Optional[float], str, bool]:
+) -> Tuple[np.ndarray, Optional[float], str, bool, Optional[str]]:
     """Parse one request frame into ``(images, deadline_ms, priority,
-    json_response)``. ``images`` is a read-only zero-copy view over the
-    body's payload bytes."""
-    flags, (n, h, w, c) = _header(body, FRAME_PREDICT, DTYPE_UINT8)
-    if flags & ~_KNOWN_FLAGS:
+    json_response, model)``. ``images`` is a read-only zero-copy view
+    over the body's payload bytes; ``model`` is None for version-1
+    frames and v2 frames without the model field — the server routes
+    those to its default model (compat policy, module docstring)."""
+    version, flags, (n, h, w, c) = _header(body, FRAME_PREDICT, DTYPE_UINT8)
+    known = _KNOWN_FLAGS[version]
+    if flags & ~known:
         raise WireError(
-            f"unknown flag bits 0x{flags & ~_KNOWN_FLAGS:02x} set "
-            f"(reserved bits must be zero in version {VERSION})"
+            f"unknown flag bits 0x{flags & ~known:02x} set "
+            f"(reserved bits must be zero in version {version})"
         )
     if n < 1:
         raise WireError(f"frame carries n={n} images (need n >= 1)")
@@ -178,6 +228,27 @@ def decode_request(
                 f"{deadline_ms}"
             )
         off += _DEADLINE.size
+    model: Optional[str] = None
+    if flags & FLAG_MODEL:  # reachable only at version >= 2 (flag check)
+        if len(body) < off + 1:
+            raise WireError(
+                "truncated frame: model flag set but the model-id "
+                "length byte is missing"
+            )
+        mlen = body[off]
+        off += 1
+        if mlen < 1:
+            raise WireError("model-id field has zero length")
+        if len(body) < off + mlen:
+            raise WireError(
+                f"truncated frame: model-id field promises {mlen} bytes, "
+                f"{len(body) - off} remain"
+            )
+        try:
+            model = bytes(body[off : off + mlen]).decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise WireError(f"model-id field is not valid UTF-8: {e}")
+        off += mlen
     expect = n * h * w * c
     if len(body) - off != expect:
         raise WireError(
@@ -190,16 +261,20 @@ def decode_request(
         deadline_ms,
         "bulk" if flags & FLAG_BULK else "interactive",
         bool(flags & FLAG_JSON_RESPONSE),
+        model,
     )
 
 
 def encode_response(logits: np.ndarray, engine_version: int) -> bytes:
-    """One logits-response frame: raw float32 bytes, bit-transparent."""
+    """One logits-response frame: raw float32 bytes, bit-transparent.
+    Response layout is unchanged by wire v2, so responses are still
+    emitted at version 1 (module docstring compat policy: the version
+    byte covers the layout, and this layout did not change)."""
     out = np.ascontiguousarray(np.asarray(logits, dtype=np.float32))
     if out.ndim != 2:
         raise ValueError(f"logits must be (n, classes), got {out.shape}")
     header = _HEADER.pack(
-        MAGIC, VERSION, FRAME_LOGITS, DTYPE_FLOAT32, 0,
+        MAGIC, VERSION_V1, FRAME_LOGITS, DTYPE_FLOAT32, 0,
         out.shape[0], out.shape[1], int(engine_version), 0,
     )
     return header + out.tobytes()
@@ -207,7 +282,7 @@ def encode_response(logits: np.ndarray, engine_version: int) -> bytes:
 
 def decode_response(body: bytes) -> Tuple[np.ndarray, int]:
     """Parse one response frame into ``(logits, engine_version)``."""
-    flags, (n, classes, engine_version, _) = _header(
+    _version, flags, (n, classes, engine_version, _) = _header(
         body, FRAME_LOGITS, DTYPE_FLOAT32
     )
     if flags:

@@ -47,21 +47,23 @@ import sys
 import numpy as np
 
 
-def _serve_http(cfg, engine, batcher, watcher, registry) -> dict:
+def _serve_http(cfg, backend, registry) -> dict:
     """Run as one HTTP replica (SERVING.md "HTTP frontend & router"):
     serve ``/predict`` + ``/healthz`` + live ``/metrics`` until
     SIGTERM/SIGINT or ``--duration_s``, drain gracefully, and return a
     loadgen-shaped report assembled from the obs registry so the
-    single-JSON-line contract keeps its keys in both modes."""
+    single-JSON-line contract keeps its keys in both modes. ``backend``
+    is a single-model BatcherBackend or a ModelZooServer — the frontend
+    is identical either way."""
     import signal
     import threading
     import time
 
     from pytorch_cifar_tpu.obs.metrics import _percentile_from_buckets
-    from pytorch_cifar_tpu.serve import BatcherBackend, ServingFrontend
+    from pytorch_cifar_tpu.serve import ServingFrontend
 
     frontend = ServingFrontend(
-        BatcherBackend(engine, batcher, watcher=watcher),
+        backend,
         host=cfg.http_host,
         port=cfg.http_port,
         registry=registry,
@@ -108,6 +110,142 @@ def _serve_http(cfg, engine, batcher, watcher, registry) -> dict:
     }
 
 
+def _main_zoo(cfg, registry, platform, compute_dtype) -> int:
+    """Multi-tenant zoo serving (``--models``; SERVING.md "Multi-tenant
+    zoo serving"): one ModelZooServer hosting every listed tenant, the
+    SAME two traffic sources as single-model mode — the built-in
+    closed-loop loadgen (now drawing a heavy-tailed zipf per-model mix
+    from the zoo sweep's cost priors) or the HTTP frontend — and ONE
+    JSON line on stdout with per-tenant blocks next to the usual
+    latency/throughput keys. Zoo tenants are single-device engines;
+    scale-out is more zoo replicas behind the model-aware router
+    (tools/router_run.py --models), not a mesh per tenant."""
+    import os
+    import time
+
+    from pytorch_cifar_tpu.obs import MetricsExporter, trace
+    from pytorch_cifar_tpu.obs.export import write_prometheus
+    from pytorch_cifar_tpu.serve import ModelZooServer, TenantSpec
+    from pytorch_cifar_tpu.serve.loadgen import run_load, zipf_mix
+    from pytorch_cifar_tpu.serve.tenancy import load_cost_priors
+
+    specs = []
+    for entry in cfg.models.split(","):
+        spec = TenantSpec.parse(
+            entry,
+            buckets=tuple(cfg.buckets),
+            num_classes=cfg.num_classes,
+            deadline_ms=cfg.deadline_ms,
+            max_batch=cfg.max_batch,
+            max_wait_ms=cfg.max_wait_ms,
+            max_queue=cfg.max_queue,
+            bulk_share=cfg.bulk_share,
+            watch=cfg.watch,
+            poll_s=cfg.poll_s,
+            seed=cfg.seed,
+        )
+        if spec.ckpt is None:
+            # per-model ckpt-dir convention: <--ckpt>/<Name> when it
+            # exists; otherwise deterministic random-init (bench/drills)
+            candidate = os.path.join(cfg.ckpt, spec.name)
+            if os.path.isdir(candidate):
+                spec.ckpt = candidate
+            else:
+                print(
+                    f"==> zoo: no checkpoint for {spec.name} "
+                    f"(looked in {candidate}); serving random-init "
+                    f"weights at seed {cfg.seed}",
+                    file=sys.stderr,
+                )
+        specs.append(spec)
+    t0 = time.perf_counter()
+    zoo = ModelZooServer(
+        specs,
+        max_resident=cfg.max_resident,
+        memory_budget_mb=cfg.zoo_memory_mb,
+        compute_dtype=compute_dtype,
+        registry=registry,
+        aot_cache_dir=cfg.aot_cache or None,
+        continuous=cfg.continuous,
+        int8=cfg.int8,
+    )
+    health = zoo.health()
+    print(
+        f"==> zoo: {len(specs)} tenants ({', '.join(zoo.models())}), "
+        f"{len(health['resident'])} resident "
+        f"(max_resident {zoo.max_resident}, budget "
+        f"{cfg.zoo_memory_mb or 'unbounded'} MiB), warm in "
+        f"{time.perf_counter() - t0:.2f}s on {platform}",
+        file=sys.stderr,
+    )
+    exporter = None
+    if cfg.metrics_out:
+        exporter = MetricsExporter(
+            registry, cfg.metrics_out, interval_s=cfg.metrics_every_s
+        ).start()
+    health = zoo.health()  # pre-close fallback if serving raises early
+    try:
+        if cfg.http_port >= 0:
+            report = _serve_http(cfg, zoo, registry)
+        else:
+            mix = zipf_mix(zoo.models(), priors=load_cost_priors())
+            report = run_load(
+                zoo,
+                clients=cfg.clients,
+                requests_per_client=cfg.requests,
+                images_max=cfg.request_images_max,
+                seed=cfg.seed,
+                duration_s=cfg.duration_s or None,
+                hedge=cfg.hedge,
+                model_mix=mix,
+            )
+        # snapshot residency/generations BEFORE the drain tears the
+        # tenants down — the record describes the serving state
+        health = zoo.health()
+    finally:
+        zoo.close()
+        if exporter is not None:
+            exporter.stop()
+        if cfg.prom_out:
+            write_prometheus(cfg.prom_out, registry.snapshot())
+        if cfg.trace_out:
+            trace.flush()
+
+    s = registry.summary()
+    out = {
+        "model": "zoo",
+        "models": zoo.models(),
+        "default_model": zoo.default_model,
+        "resident": health["resident"],
+        "max_resident": zoo.max_resident,
+        "memory_budget_mb": cfg.zoo_memory_mb,
+        "platform": platform,
+        "dtype": cfg.dtype,
+        "zoo": zoo.stats,
+        "admission_ms_p50": round(
+            s.get("serve.zoo.admission_ms.p50", 0.0), 3
+        ),
+        "tenants": {
+            name: {
+                k: t.get(k)
+                for k in (
+                    "resident", "admissions", "evictions",
+                    "engine_version", "ckpt_epoch",
+                    "promotion_generation", "compiles",
+                    "aot_cache_hits",
+                )
+            }
+            for name, t in health["tenants"].items()
+        },
+        **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in report.items()
+        },
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def main() -> int:
     from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
     from pytorch_cifar_tpu.config import parse_serve_config
@@ -145,6 +283,11 @@ def main() -> int:
     compute_dtype = (
         jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
     )
+
+    if cfg.models:
+        # multi-tenant zoo serving: its own report shape (per-tenant
+        # blocks); the single-model path below stays byte-identical
+        return _main_zoo(cfg, registry, platform, compute_dtype)
 
     # data-parallel serving mesh, mirroring train's --num_devices (0 =
     # all local devices). A 1-device request keeps the exact single-chip
@@ -244,7 +387,12 @@ def main() -> int:
 
     try:
         if cfg.http_port >= 0:
-            report = _serve_http(cfg, engine, batcher, watcher, registry)
+            from pytorch_cifar_tpu.serve import BatcherBackend
+
+            report = _serve_http(
+                cfg, BatcherBackend(engine, batcher, watcher=watcher),
+                registry,
+            )
         else:
             report = run_load(
                 batcher,

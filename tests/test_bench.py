@@ -217,6 +217,46 @@ def test_bench_serve_mode_prints_one_json_line():
     assert q["compiles"] >= 1
 
 
+def test_bench_serve_zoo_mode_prints_one_json_line():
+    """--serve-zoo (multi-tenant zoo serving PR): the driver contract
+    for one ModelZooServer under a heavy-tailed mix — per-model img/s,
+    the zipf mix weights, the zoo-vs-dedicated throughput A/B, and the
+    eviction/re-admission block with its acceptance pin (re-admission
+    is an AOT-cache import: compiles == 0, hits > 0)."""
+    rec, _ = run_bench(
+        ["--serve-zoo", "--steps", "2", "--models", "LeNet,MobileNet"],
+        timeout=900,
+    )
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"] == "serve_zoo_2tenants_bfloat16_cpu", rec
+    assert rec["unit"] == "images/sec"
+    assert rec["value"] > 0
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    assert rec["p99_ms"] >= rec["p50_ms"] > 0
+    # heavy-tailed mix: both tenants present, weights sum to ~1, the
+    # hot model really got the bulk of the traffic
+    assert set(rec["mix"]) == {"LeNet", "MobileNet"}
+    assert abs(sum(rec["mix"].values()) - 1.0) < 0.01
+    assert set(rec["per_model"]) == {"LeNet", "MobileNet"}
+    assert sum(rec["per_model"].values()) == rec["requests"]
+    assert rec["per_model"][rec["hot_model"]] == max(
+        rec["per_model"].values()
+    )
+    assert set(rec["per_model_img_per_sec"]) == {"LeNet", "MobileNet"}
+    # the zoo-vs-dedicated A/B (a ratio is a measurement, not a schema
+    # guarantee on a 1-core box — presence and positivity are)
+    assert rec["dedicated_img_per_sec"] > 0
+    assert rec["zoo_vs_dedicated"] > 0
+    # eviction/re-admission: churn really happened and the re-admitted
+    # tenant cold-started from the AOT cache — THE acceptance pin
+    ev = rec["eviction"]
+    assert ev["evictions"] >= 2
+    assert ev["admission_ms_p50"] > 0
+    assert ev["readmit_compiles"] == 0
+    assert ev["readmit_aot_hits"] > 0
+    assert rec["obs"]["unknown_model"] == 0.0
+
+
 def test_parse_child_record_skips_non_record_json_lines():
     """headline()'s child-stdout parsing (ADVICE round 5): stray brace-
     prefixed lines — dependency JSON warnings, malformed braces — must

@@ -170,6 +170,33 @@ def test_router_drill_sigkill_replica_under_load(tmp_path):
     assert rec["router_rc"] == 0
 
 
+def test_zoo_drill_skewed_load_churn_and_replica_kill(tmp_path):
+    """--mode zoo (SERVING.md "Multi-tenant zoo serving"): a 2-replica
+    3-model zoo fleet (max_resident=2 — the tail tenant structurally
+    forces eviction churn) under a skewed heavy-tailed per-model mix.
+    Asserted: per-model /predict bit-identical across both replicas and
+    the router over BOTH wire encodings (including across evict →
+    re-admit cycles); replica 0 SIGKILLed mid-load with ZERO
+    client-visible errors in every phase; re-admitted tenants report
+    aot_cache hits with compile_count == 0; the router evicts the
+    corpse and exits 0 at drain."""
+    rec = run_chaos("zoo", tmp_path, extra=("--epochs", "2"))
+    assert rec["match"] is True
+    assert rec["warm_replica_compiles"] == 0
+    assert all(rec["per_model_bit_identical"].values())
+    assert rec["post_kill_bits_match"] is True
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    # the skew was real: the hot model dominated
+    hot = max(rec["mix"], key=rec["mix"].get)
+    assert rec["per_model_requests"][hot] == max(
+        rec["per_model_requests"].values()
+    )
+    assert rec["churned_tenants"]  # forced eviction churn happened
+    assert rec["readmit_compiles_zero"] is True
+    assert rec["evictions"] >= 1 and rec["healthy_after"] == 1
+    assert rec["router_rc"] == 0
+
+
 def test_canary_drill_bad_checkpoints_contained_good_promotes(tmp_path):
     """--mode canary (ROBUSTNESS.md "canary promotion"): under sustained
     mixed-priority HTTP load, NaN'd + bitflipped + regressed checkpoints

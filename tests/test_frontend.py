@@ -191,20 +191,30 @@ def _post_binary(url, frame, timeout=30):
 def test_wire_frame_roundtrip_unit():
     """The frame codec in isolation: request and response survive an
     encode/decode round trip byte-exactly, with every header field
-    (deadline, priority, response-encoding flag) preserved."""
+    (deadline, priority, response-encoding flag, v2 model id)
+    preserved. Model-less frames are emitted at VERSION 1 — the compat
+    contract that keeps every pre-zoo client working — and model-
+    carrying frames at VERSION 2."""
     x = _images(4, seed=11)
-    for deadline, priority, json_resp in (
-        (None, "interactive", False),
-        (250.0, "bulk", False),
-        (0.0, "interactive", True),
+    for deadline, priority, json_resp, model in (
+        (None, "interactive", False, None),
+        (250.0, "bulk", False, None),
+        (0.0, "interactive", True, None),
+        (None, "interactive", False, "ResNet18"),
+        (125.0, "bulk", True, "VGG16"),
     ):
         frame = wire.encode_request(
             x, deadline_ms=deadline, priority=priority,
-            json_response=json_resp,
+            json_response=json_resp, model=model,
         )
-        x2, d2, p2, j2 = wire.decode_request(frame, (32, 32, 3), 4096)
+        # the version byte IS the compat contract (SERVING.md)
+        assert frame[4] == (
+            wire.VERSION_V1 if model is None else wire.VERSION
+        )
+        x2, d2, p2, j2, m2 = wire.decode_request(frame, (32, 32, 3), 4096)
         assert np.array_equal(x2, x)
         assert d2 == deadline and p2 == priority and j2 == json_resp
+        assert m2 == model
     logits = np.random.RandomState(3).randn(4, 10).astype(np.float32)
     out, version = wire.decode_response(wire.encode_response(logits, 9))
     assert np.array_equal(out, logits) and version == 9
@@ -322,6 +332,220 @@ def test_http_target_binary_and_mixed_wire(lenet_stack):
         assert rep["failed"] == 0 and rep["requests"] == 8
     with pytest.raises(ValueError):
         HttpTarget(frontend.url, wire="carrier-pigeon")
+
+
+# -- multi-tenant zoo routing (serve/tenancy.py; wire v2) ---------------
+
+
+@pytest.fixture(scope="module")
+def zoo_stack():
+    """A 2-tenant ModelZooServer behind the SAME frontend (module-
+    scoped: one LeNet+MobileNet warmup for every routing case)."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import ModelZooServer, TenantSpec
+
+    zoo = ModelZooServer(
+        [
+            TenantSpec("LeNet", buckets=(1, 4), seed=0),
+            TenantSpec("MobileNet", buckets=(1, 4), seed=1),
+        ],
+        compute_dtype=jnp.float32,
+    )
+    frontend = ServingFrontend(zoo).start()
+    yield zoo, frontend
+    frontend.stop()
+    zoo.close()
+
+
+def test_zoo_routing_bit_identical_both_encodings(zoo_stack):
+    """Model-id routing through the full HTTP path: the JSON ``model``
+    field and the wire-v2 frame field both reach the named tenant, and
+    the answers are bit-identical to the zoo's in-process predict. A
+    model-LESS request (a v1 binary frame / plain JSON — every pre-zoo
+    client) routes to the default tenant."""
+    zoo, frontend = zoo_stack
+    x = _images(3, seed=41)
+    want = {m: zoo.predict(x, model=m) for m in ("LeNet", "MobileNet")}
+    for m in ("LeNet", "MobileNet"):
+        status, resp = _post(
+            frontend.url, _b64_payload(x, encoding="b64", model=m)
+        )
+        assert status == 200
+        assert np.array_equal(decode_logits(resp), want[m]), m
+        status, _, body = _post_binary(
+            frontend.url, wire.encode_request(x, model=m)
+        )
+        assert status == 200
+        assert np.array_equal(wire.decode_response(body)[0], want[m]), m
+    # v1 frame (no model field possible) -> the default tenant
+    frame = wire.encode_request(x)
+    assert frame[4] == wire.VERSION_V1
+    status, _, body = _post_binary(frontend.url, frame)
+    assert status == 200
+    assert np.array_equal(wire.decode_response(body)[0], want["LeNet"])
+
+
+def test_zoo_unknown_model_404_json_body(zoo_stack):
+    """A well-formed request naming an unhosted model is 404 with a
+    parseable JSON error body — on BOTH encodings (the wire-v2 compat
+    contract: the frame was valid, the tenant is absent — distinct
+    from the 400 malformed-frame class)."""
+    _, frontend = zoo_stack
+    x = _images(1, seed=42)
+    for data, ctype in (
+        (
+            json.dumps(
+                {"images": x.tolist(), "model": "NoSuchNet"}
+            ).encode(),
+            "application/json",
+        ),
+        (wire.encode_request(x, model="NoSuchNet"), wire.CONTENT_TYPE),
+    ):
+        req = urllib.request.Request(
+            frontend.url + "/predict", data=data,
+            headers={"Content-Type": ctype},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 404
+        err = json.load(ei.value)
+        assert "NoSuchNet" in err["error"]
+
+
+def test_zoo_healthz_reports_tenants(zoo_stack):
+    """/healthz on a zoo frontend carries residency + the per-tenant
+    generation block — one scrape shows the whole zoo."""
+    _, frontend = zoo_stack
+    _, body = _get(frontend.url, "/healthz")
+    h = json.loads(body)
+    assert h["status"] == "ok" and h["role"] == "zoo"
+    assert h["models"] == ["LeNet", "MobileNet"]
+    assert set(h["resident"]) <= set(h["models"])
+    for t in h["tenants"].values():
+        assert {"resident", "admissions", "evictions"} <= set(t)
+
+
+def test_single_model_replica_accepts_own_name_404s_others(lenet_stack):
+    """A pre-zoo single-model replica named EXPLICITLY by its own model
+    id answers normally; any other id is a 404 — so zoo-aware clients
+    work against mixed fleets without the replica growing a zoo."""
+    engine, _, frontend = lenet_stack
+    x = _images(2, seed=43)
+    status, resp = _post(
+        frontend.url, _b64_payload(x, encoding="b64", model="LeNet")
+    )
+    assert status == 200
+    assert np.array_equal(decode_logits(resp), engine.predict(x))
+    status, _, body = _post_binary(
+        frontend.url, wire.encode_request(x, model="LeNet")
+    )
+    assert status == 200
+    assert np.array_equal(wire.decode_response(body)[0], engine.predict(x))
+    for data, ctype in (
+        (
+            json.dumps({"images": x.tolist(), "model": "VGG16"}).encode(),
+            "application/json",
+        ),
+        (wire.encode_request(x, model="VGG16"), wire.CONTENT_TYPE),
+    ):
+        req = urllib.request.Request(
+            frontend.url + "/predict", data=data,
+            headers={"Content-Type": ctype},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 404
+
+
+def test_malformed_wire_v2_model_frames_get_400():
+    """The wire-v2 malformed classes (satellite contract): FLAG_MODEL
+    set on a VERSION-1 frame (reserved bit), a truncated model-id
+    length byte, a truncated model-id body, a zero-length model id,
+    and undecodable UTF-8 all map to 400 with a JSON reason — never
+    touching the backend; a well-formed unknown model stays 404 (see
+    test_zoo_unknown_model_404_json_body)."""
+    stub = StubBackend()
+    x = _images(1, seed=44)
+    v1 = wire.encode_request(x)
+    v2 = wire.encode_request(x, model="LeNet")
+    payload = x.tobytes()
+    head_v2 = v2[: wire.HEADER_SIZE]
+
+    def v2_with_model_field(field):
+        return head_v2 + field + payload
+
+    cases = [
+        # reserved bit in v1: the pre-zoo rejection, still enforced
+        v1[:7] + bytes([v1[7] | wire.FLAG_MODEL]) + v1[8:],
+        # v2 with FLAG_MODEL but nothing after the header
+        head_v2,
+        # length byte promises more bytes than the frame holds
+        head_v2 + bytes([200]) + b"LeNet",
+        # zero-length model id
+        v2_with_model_field(bytes([0])),
+        # invalid UTF-8 model id
+        v2_with_model_field(bytes([2]) + b"\xff\xfe"),
+    ]
+    with ServingFrontend(stub) as fe:
+        for body in cases:
+            req = urllib.request.Request(
+                fe.url + "/predict", data=body,
+                headers={"Content-Type": wire.CONTENT_TYPE},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400, body[:32]
+            assert json.load(ei.value)["error"]
+    assert stub.calls == 0
+
+
+class ZooStub(StubBackend):
+    """A routing-aware stub: answers only its own model list, raising
+    the zoo's UnknownModel otherwise — the router protocol without a
+    jax engine."""
+
+    supports_model_routing = True
+
+    def __init__(self, tag, models):
+        super().__init__(tag=tag)
+        self.models = list(models)
+
+    def predict(self, images, deadline_ms=None, priority="interactive",
+                model=None):
+        from pytorch_cifar_tpu.serve.tenancy import UnknownModel
+
+        if model is not None and model not in self.models:
+            raise UnknownModel(f"model {model!r} not hosted")
+        return super().predict(images, deadline_ms, priority)
+
+    def health(self):
+        return {
+            "status": "ok", "role": "zoo", "tag": self.tag,
+            "models": self.models,
+        }
+
+
+def test_router_model_aware_dispatch_and_404():
+    """Model-aware fleet dispatch: the router sends each model only to
+    replicas whose probed health advertises it (tenants sharded across
+    the fleet), and a model NOBODY hosts surfaces as the deterministic
+    404 class (UnknownModel), never a hedge storm or a 503."""
+    from pytorch_cifar_tpu.serve.tenancy import UnknownModel
+
+    a = ZooStub(1.0, ["ModelA"])
+    b = ZooStub(2.0, ["ModelB"])
+    with ServingFrontend(a) as fa, ServingFrontend(b) as fb:
+        with Router([fa.url, fb.url]) as r:
+            assert r.probe_once() == 2  # health (incl. models) cached
+            for _ in range(4):
+                out = r.predict(_images(1), model="ModelA")
+                assert float(out[0, 0]) == 1.0  # only A's replica
+                out = r.predict(_images(1), model="ModelB")
+                assert float(out[0, 0]) == 2.0  # only B's replica
+            with pytest.raises(UnknownModel):
+                r.predict(_images(1), model="ModelC")
+            assert r.stats["hedged"] == 0  # routing, not retrying
 
 
 # -- /healthz ----------------------------------------------------------

@@ -801,6 +801,17 @@ def test_parse_serve_config_buckets_and_defaults():
     assert parse_serve_config([]).int8 is False
     cfg = parse_serve_config(["--no-continuous", "--int8"])
     assert cfg.continuous is False and cfg.int8 is True
+    # multi-tenant zoo knobs: single-model mode by default, unbounded
+    # residency until asked otherwise
+    assert parse_serve_config([]).models == ""
+    assert parse_serve_config([]).max_resident == 0
+    assert parse_serve_config([]).zoo_memory_mb == 0.0
+    cfg = parse_serve_config(
+        ["--models", "LeNet=/tmp/a,MobileNet", "--max_resident", "1",
+         "--zoo_memory_mb", "64"]
+    )
+    assert cfg.models == "LeNet=/tmp/a,MobileNet"
+    assert cfg.max_resident == 1 and cfg.zoo_memory_mb == 64.0
 
 
 def test_loadgen_reports_latency_percentiles(lenet_engine):

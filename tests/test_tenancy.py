@@ -1,0 +1,476 @@
+"""Multi-tenant model-zoo serving tier-1 tests (serve/tenancy.py;
+SERVING.md "Multi-tenant zoo serving").
+
+What is pinned here:
+
+- **routing bit-identity**: a zoo tenant's ``predict`` equals a
+  dedicated single-model engine's BIT-for-bit (by name and through the
+  default-model route) — the zoo multiplexes, it never changes answers;
+- **evict → re-admit bit-identity with zero compiles**: placement churn
+  reloads a tenant through the shared AOT cache (probe-verified import,
+  ``compile_count == 0``) and its logits are byte-equal across the
+  cycle;
+- **cost-prior-seeded LRU**: eager placement admits the costliest
+  models first and pre-traffic eviction takes the cheapest;
+- **budgets**: ``max_resident`` and ``memory_budget_mb`` both bound the
+  resident set; admission under contention builds exactly once;
+- **per-tenant SLOs**: each tenant's admission queue carries its own
+  default deadline;
+- **per-tenant canary isolation**: one tenant's NaN candidate
+  quarantines while every other tenant's bits are untouched;
+- **per-tenant hot reload**: a republished checkpoint swaps into ONE
+  tenant's engine (generation bumps, health tracks);
+- **unknown-model semantics**: UnknownModel (the 404 class), counted;
+- the loadgen's heavy-tailed ``model_mix`` / :func:`zipf_mix` surface
+  and the labeled-eval golden fallback (the accuracy-gate satellite).
+
+The HTTP/wire-v2 halves live in test_frontend.py; the fleet drill is
+``tools/chaos_run.py --mode zoo`` (slow, test_chaos.py); the
+throughput/eviction-latency contract is ``bench.py --serve-zoo``
+(test_bench.py).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_cifar_tpu.serve import (
+    InferenceEngine,
+    ModelZooServer,
+    TenantSpec,
+    UnknownModel,
+)
+from pytorch_cifar_tpu.serve.loadgen import run_load, zipf_mix
+
+# the two cheapest zoo architectures on CPU — tenancy mechanics do not
+# depend on the model, only on there being more than one
+MODELS = ("LeNet", "MobileNet")
+BUCKETS = (1, 4)
+
+
+def _images(n, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def zoo_cache(tmp_path_factory):
+    """One shared AOT cache for the whole module: the first zoo build
+    pays the compiles and exports; every later build (and every
+    re-admission) imports — which is exactly the production shape."""
+    return str(tmp_path_factory.mktemp("zoo_aot"))
+
+
+def _specs(**kw):
+    return [
+        TenantSpec(m, buckets=BUCKETS, seed=i, **kw)
+        for i, m in enumerate(MODELS)
+    ]
+
+
+def _zoo(zoo_cache, specs=None, **kw):
+    return ModelZooServer(
+        specs if specs is not None else _specs(),
+        compute_dtype=jnp.float32,
+        aot_cache_dir=zoo_cache,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def dedicated():
+    """Dedicated single-model engines at the SAME seeds as the zoo
+    specs — the bit-identity oracles."""
+    return {
+        m: InferenceEngine.from_random(
+            m, seed=i, buckets=BUCKETS, compute_dtype=jnp.float32
+        )
+        for i, m in enumerate(MODELS)
+    }
+
+
+# -- routing bit-identity ----------------------------------------------
+
+
+def test_zoo_predict_bit_identical_to_dedicated(zoo_cache, dedicated):
+    """The tentpole bar: every tenant's answers equal a dedicated
+    single-model engine's bit-for-bit — by explicit model id and (for
+    the first-listed tenant) through the default route."""
+    with _zoo(zoo_cache) as zoo:
+        x = _images(3, seed=1)
+        for m in MODELS:
+            assert np.array_equal(
+                zoo.predict(x, model=m), dedicated[m].predict(x)
+            ), m
+        # no model id -> the default (first-listed) tenant
+        assert zoo.default_model == MODELS[0]
+        assert np.array_equal(
+            zoo.predict(x), dedicated[MODELS[0]].predict(x)
+        )
+
+
+def test_unknown_model_raises_and_counts(zoo_cache):
+    with _zoo(zoo_cache) as zoo:
+        with pytest.raises(UnknownModel):
+            zoo.predict(_images(1), model="NoSuchNet")
+        with pytest.raises(UnknownModel):
+            zoo.submit(_images(1), model="AlsoNot")
+        assert zoo.stats["unknown_model"] == 2
+    # a spec naming an unregistered model fails at construction
+    with pytest.raises(KeyError):
+        TenantSpec("NoSuchNet")
+
+
+def test_tenant_spec_parse_grammar():
+    spec = TenantSpec.parse("LeNet=/tmp/somewhere")
+    assert spec.name == "LeNet" and spec.ckpt == "/tmp/somewhere"
+    spec = TenantSpec.parse("  MobileNet  ")
+    assert spec.name == "MobileNet" and spec.ckpt is None
+
+
+# -- placement / eviction ----------------------------------------------
+
+
+def test_evict_readmit_bit_identical_with_zero_compiles(zoo_cache):
+    """The acceptance bar for placement churn: a max_resident=1 zoo
+    alternating two tenants evicts and re-admits on every switch — the
+    re-admitted tenant's logits are byte-equal to its first admission's
+    and the reload is an AOT-cache import (compile_count == 0), never a
+    compile storm."""
+    with _zoo(zoo_cache, max_resident=1) as zoo:
+        x = _images(5, seed=2)  # off-bucket: padding rides the cycle too
+        first = {m: zoo.predict(x, model=m) for m in MODELS}
+        assert zoo.stats["evictions"] >= 1  # the 2nd admit evicted the 1st
+        again = {m: zoo.predict(x, model=m) for m in MODELS}
+        for m in MODELS:
+            assert np.array_equal(first[m], again[m]), m
+        h = zoo.health()["tenants"]
+        for m in MODELS:
+            assert h[m]["evictions"] >= 1, m
+        # the CURRENTLY resident tenant was just re-admitted: zero
+        # compiles, hits for every bucket
+        resident = [m for m in MODELS if h[m]["resident"]]
+        assert len(resident) == 1
+        assert h[resident[0]]["compiles"] == 0
+        assert h[resident[0]]["aot_cache_hits"] == len(BUCKETS)
+
+
+def test_cost_prior_seeded_placement_and_eviction(zoo_cache):
+    """Priors drive placement: with one resident slot, eager placement
+    admits the COSTLIEST model (lowest img/s prior), and the first
+    eviction takes the cheapest."""
+    # declare LeNet cheap (fast) and MobileNet costly (slow)
+    priors = {"LeNet": 100_000.0, "MobileNet": 1_000.0}
+    zoo = _zoo(zoo_cache, max_resident=1, cost_priors=priors)
+    try:
+        assert zoo.health()["resident"] == ["MobileNet"]  # costliest held
+        # a request for the cheap tenant churns the slot...
+        zoo.predict(_images(1), model="LeNet")
+        assert zoo.health()["resident"] == ["LeNet"]
+        # ...and real traffic overrides the seed: LeNet was used LAST,
+        # so admitting MobileNet evicts LeNet (plain LRU from here on)
+        zoo.predict(_images(1), model="MobileNet")
+        assert zoo.health()["resident"] == ["MobileNet"]
+    finally:
+        zoo.close()
+
+
+def test_memory_budget_bounds_resident_set(zoo_cache):
+    """The byte budget is a placement bound like max_resident: with
+    room for only one tenant's weights, touching both keeps exactly one
+    resident (LeNet ~0.25 MiB x2, MobileNet ~12 MiB x2 estimated)."""
+    zoo = _zoo(zoo_cache, memory_budget_mb=2.0)
+    try:
+        zoo.predict(_images(1), model="LeNet")
+        zoo.predict(_images(1), model="MobileNet")
+        h = zoo.health()
+        assert len(h["resident"]) == 1
+        assert h["memory_budget_bytes"] == 2 * 1024 * 1024
+        assert zoo.stats["evictions"] >= 1
+    finally:
+        zoo.close()
+
+
+def test_concurrent_admission_builds_once(zoo_cache):
+    """N threads racing a non-resident tenant: exactly ONE pays the
+    build (the others wait on the condition), and everyone's answer is
+    correct."""
+    zoo = _zoo(zoo_cache, eager=False)
+    try:
+        x = _images(2, seed=3)
+        outs = [None] * 4
+        errs = []
+
+        def hit(i):
+            try:
+                outs[i] = zoo.predict(x, model="LeNet")
+            except Exception as e:  # pragma: no cover - fail loudly below
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=hit, args=(i,)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert all(np.array_equal(outs[0], o) for o in outs[1:])
+        assert zoo.health()["tenants"]["LeNet"]["admissions"] == 1
+    finally:
+        zoo.close()
+
+
+def test_eviction_drains_admitted_requests(zoo_cache):
+    """Eviction is a drain, not a drop: requests admitted to a tenant's
+    queue before churn are answered from the old engine — placement can
+    never lose in-flight work."""
+    zoo = _zoo(zoo_cache, max_resident=1)
+    try:
+        x = _images(3, seed=4)
+        futs = [zoo.submit(x, model="LeNet") for _ in range(4)]
+        # force churn while those futures may still be queued
+        zoo.predict(_images(1), model="MobileNet")
+        want = None
+        for f in futs:
+            out = f.result(timeout=60)
+            if want is None:
+                want = out
+            assert np.array_equal(out, want)
+    finally:
+        zoo.close()
+
+
+# -- SLOs, health, metrics ---------------------------------------------
+
+
+def test_per_tenant_slo_deadline_configures_queue(zoo_cache):
+    """Each tenant's admission queue carries the tenant's own SLO as
+    its default queue-time bound (per-request deadline_ms still
+    overrides at submit)."""
+    specs = [
+        TenantSpec("LeNet", buckets=BUCKETS, seed=0, deadline_ms=123.0),
+        TenantSpec(
+            "MobileNet", buckets=BUCKETS, seed=1, deadline_ms=456.0
+        ),
+    ]
+    zoo = _zoo(zoo_cache, specs=specs)
+    try:
+        zoo.predict(_images(1), model="LeNet")
+        zoo.predict(_images(1), model="MobileNet")
+        assert (
+            zoo._tenants["LeNet"].batcher.default_deadline_ms == 123.0
+        )
+        assert (
+            zoo._tenants["MobileNet"].batcher.default_deadline_ms
+            == 456.0
+        )
+        h = zoo.health()["tenants"]
+        assert h["LeNet"]["deadline_ms"] == 123.0
+        assert h["MobileNet"]["deadline_ms"] == 456.0
+    finally:
+        zoo.close()
+
+
+def test_health_and_per_model_metrics(zoo_cache):
+    """/healthz shape + the per-model metric families: residency, the
+    budget gauges, and serve.tenant.{model}.* counters that move with
+    traffic."""
+    zoo = _zoo(zoo_cache)
+    try:
+        zoo.predict(_images(2), model="MobileNet")
+        h = zoo.health()
+        assert h["status"] == "ok" and h["role"] == "zoo"
+        assert h["models"] == sorted(MODELS)
+        assert set(h["resident"]) == set(MODELS)
+        assert h["max_resident"] == len(MODELS)
+        assert h["memory_bytes"] > 0
+        t = h["tenants"]["MobileNet"]
+        assert t["resident"] and t["engine_version"] == 0
+        assert t["buckets"] == list(BUCKETS)
+        assert t["queued"] == {"interactive": 0, "bulk": 0}
+        s = zoo.obs.summary()
+        assert s.get("serve.tenant.MobileNet.requests") == 1.0
+        assert s.get("serve.tenant.MobileNet.images") == 2.0
+        assert s.get("serve.zoo.resident.max") == float(len(MODELS))
+        assert s.get("serve.zoo.admission_ms.count", 0) >= 2
+    finally:
+        zoo.close()
+
+
+# -- per-tenant hot reload + canary isolation --------------------------
+
+
+def _save_lenet_checkpoint(out_dir, seed, epoch, best_acc):
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    state = create_train_state(
+        create_model("LeNet"),
+        jax.random.PRNGKey(seed),
+        make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2),
+    )
+    save_checkpoint(str(out_dir), state, epoch=epoch, best_acc=best_acc)
+    return state
+
+
+def test_per_tenant_hot_reload_swaps_one_tenant(zoo_cache, tmp_path,
+                                                dedicated):
+    """A republished checkpoint hot-swaps into ITS tenant's engine only:
+    the watched tenant's generation bumps and its answers change; the
+    other tenant's bits never move."""
+    live = tmp_path / "lenet_live"
+    _save_lenet_checkpoint(live, seed=0, epoch=1, best_acc=10.0)
+    specs = [
+        # poll_s huge: the poll thread stays inert, tests drive
+        # poll_once deterministically
+        TenantSpec(
+            "LeNet", str(live), buckets=BUCKETS, watch=True, poll_s=600.0
+        ),
+        TenantSpec("MobileNet", buckets=BUCKETS, seed=1),
+    ]
+    zoo = _zoo(zoo_cache, specs=specs)
+    try:
+        x = _images(3, seed=5)
+        before = zoo.predict(x, model="LeNet")
+        mobile_before = zoo.predict(x, model="MobileNet")
+        _save_lenet_checkpoint(live, seed=9, epoch=2, best_acc=20.0)
+        watcher = zoo._tenants["LeNet"].watcher
+        assert watcher is not None and watcher.poll_once() is True
+        after = zoo.predict(x, model="LeNet")
+        assert not np.array_equal(before, after)  # new weights serve
+        h = zoo.health()["tenants"]
+        assert h["LeNet"]["engine_version"] == 1
+        assert h["LeNet"]["ckpt_epoch"] == 2
+        assert h["LeNet"]["reloads"] == 1
+        # the OTHER tenant is untouched: same generation, same bits
+        assert h["MobileNet"]["engine_version"] == 0
+        assert np.array_equal(
+            zoo.predict(x, model="MobileNet"), mobile_before
+        )
+    finally:
+        zoo.close()
+
+
+def test_per_tenant_canary_quarantines_without_touching_others(
+    zoo_cache, tmp_path
+):
+    """The isolation bar from the acceptance criteria: a NaN candidate
+    for one tenant quarantines through that tenant's OWN promotion
+    controller; the victim keeps serving its incumbent bits and the
+    other tenant's answers never waver."""
+    from pytorch_cifar_tpu import faults
+    from pytorch_cifar_tpu.serve import CanaryBudget
+    from pytorch_cifar_tpu.train.checkpoint import (
+        ensure_staging_dir,
+        is_quarantined,
+        save_checkpoint,
+    )
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    live = tmp_path / "lenet_live"
+    _save_lenet_checkpoint(live, seed=0, epoch=1, best_acc=10.0)
+    staging = ensure_staging_dir(str(live))
+    specs = [
+        TenantSpec("LeNet", str(live), buckets=BUCKETS),
+        TenantSpec("MobileNet", buckets=BUCKETS, seed=1),
+    ]
+    zoo = _zoo(zoo_cache, specs=specs)
+    ctl = None
+    try:
+        x = _images(3, seed=6)
+        lenet_pre = zoo.predict(x, model="LeNet")
+        mobile_pre = zoo.predict(x, model="MobileNet")
+        ctl = zoo.enable_canary(
+            "LeNet", staging, budget=CanaryBudget(max_flip_frac=1.0)
+        )
+        # a NaN'd candidate lands in the tenant's staging dir
+        state = create_train_state(
+            create_model("LeNet"),
+            jax.random.PRNGKey(3),
+            make_optimizer(lr=0.1, t_max=10, steps_per_epoch=2),
+        )
+        save_checkpoint(staging, state, epoch=2, best_acc=50.0)
+        faults.regress_checkpoint(staging, nan=True)
+        assert ctl.poll_once() == "quarantined"
+        assert is_quarantined(staging, "ckpt.msgpack")
+        # the victim tenant still serves the INCUMBENT bits (nothing was
+        # promoted into its live dir)...
+        assert np.array_equal(zoo.predict(x, model="LeNet"), lenet_pre)
+        # ...and the bystander tenant's bits and generation are
+        # untouched — per-tenant blast radius, the whole point
+        assert np.array_equal(
+            zoo.predict(x, model="MobileNet"), mobile_pre
+        )
+        h = zoo.health()["tenants"]
+        assert h["LeNet"]["canary"]["state"] == "quarantined"
+        assert h["LeNet"]["canary"]["rejected"] == 1
+        assert h["MobileNet"]["engine_version"] == 0
+        assert "canary" not in h["MobileNet"]
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        zoo.close()
+
+
+# -- loadgen surface ----------------------------------------------------
+
+
+def test_zipf_mix_heavy_tail_and_prior_ordering():
+    mix = zipf_mix(["A", "B", "C"])
+    assert abs(sum(mix.values()) - 1.0) < 1e-9
+    assert mix["A"] > mix["B"] > mix["C"]  # given order = rank order
+    # priors reorder: the CHEAPEST (highest img/s) model is the hot one
+    mix = zipf_mix(["A", "B"], priors={"A": 10.0, "B": 1000.0})
+    assert mix["B"] > mix["A"]
+
+
+def test_run_load_model_mix_over_zoo(zoo_cache):
+    """The closed loop drives the zoo through its submit surface with a
+    heavy-tailed mix: zero failures, per-model counts in the report and
+    in the per-tenant counters."""
+    zoo = _zoo(zoo_cache)
+    try:
+        mix = zipf_mix(list(MODELS))
+        rep = run_load(
+            zoo, clients=3, requests_per_client=4, images_max=3, seed=7,
+            model_mix=mix,
+        )
+        assert rep["failed"] == 0 and rep["requests"] == 12
+        assert set(rep["per_model"]) == set(MODELS)
+        assert sum(rep["per_model"].values()) == 12
+        assert rep["per_model"][MODELS[0]] >= rep["per_model"][MODELS[1]]
+        s = zoo.obs.summary()
+        counted = sum(
+            s.get(f"serve.tenant.{m}.requests", 0.0) for m in MODELS
+        )
+        assert counted == 12.0
+    finally:
+        zoo.close()
+
+
+# -- the labeled-eval golden satellite ---------------------------------
+
+
+def test_labeled_eval_falls_back_to_synthetic(tmp_path):
+    """Offline (no CIFAR-10 archive, download off), labeled_eval serves
+    the deterministic synthetic eval split WITH labels — the accuracy
+    gate applies either way; only the labels' provenance differs."""
+    from pytorch_cifar_tpu.data.cifar10 import synthetic_cifar10
+    from pytorch_cifar_tpu.serve import GoldenSet
+
+    golden = GoldenSet.labeled_eval(str(tmp_path / "nodata"), limit=32)
+    assert golden.labels is not None and len(golden) == 32
+    _, _, x, y = synthetic_cifar10()
+    assert np.array_equal(golden.images, x[:32])
+    assert np.array_equal(golden.labels, y[:32])

@@ -48,6 +48,16 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
              checkpoint B must auto-promote (live epoch/generation
              advance, the watcher hot-loads it) with zero failed client
              requests across the whole drill.
+  zoo      — multi-tenant fleet drill (SERVING.md "Multi-tenant zoo
+             serving"): a 2-replica zoo fleet (3 models, max_resident=2
+             so the tail tenant forces eviction churn) serves a skewed
+             heavy-tailed per-model mix; per-model /predict must be
+             bit-identical across both replicas and the router (both
+             wire encodings, across evict/re-admit cycles), replica 0
+             is SIGKILLed mid-load with ZERO client-visible errors
+             (router hedges absorb the loss), re-admitted tenants must
+             report aot_cache hits with compile_count == 0, and the
+             router must evict the corpse and exit 0 at drain.
   router   — fleet drill (SERVING.md "HTTP frontend & router"): a
              2-replica fleet behind tools/router_run.py serves sustained
              mixed-priority HTTP load; one replica is SIGKILLed
@@ -611,6 +621,264 @@ def router_drill(args, work: str) -> dict:
     }
 
 
+def zoo_drill(args, work: str) -> dict:
+    """The multi-tenant zoo drill (SERVING.md "Multi-tenant zoo
+    serving"): a 2-replica zoo fleet (LeNet from a REAL trained
+    checkpoint + MobileNet + VGG11 random-init, identical seeds across
+    replicas) with ``max_resident=2`` — the third tenant structurally
+    forces eviction churn — under a skewed heavy-tailed per-model mix,
+    with replica 0 SIGKILLed mid-load.
+
+    Phases:
+      0. fleet-up: router_run --models spawns 2 zoo replicas behind the
+         model-aware router (shared AOT cache: replica 1 joins with
+         per-tenant compiles == 0).
+      1. per-model bit-identity probe: the same payload to replica 0,
+         replica 1, and the router, over BOTH wire encodings, for EVERY
+         model — byte-equal logits per model (probing all 3 models
+         through a 2-resident zoo is itself eviction churn, so identity
+         is asserted ACROSS evict/re-admit cycles).
+      2. steady + kill + post-evict load: closed-loop mixed-priority
+         mixed-wire clients drawing the zipf model mix; replica 0 is
+         SIGKILLed mid-phase. ZERO client-visible errors in every phase
+         (the router's hedge absorbs the in-flight loss), and the
+         corpse is evicted.
+      3. survivor audit: every resident tenant that was evicted and
+         re-admitted reports aot_cache hits and compile_count == 0, and
+         the per-model router answers still match phase 1's bits.
+      4. drain: SIGTERM to router_run exits 0.
+    """
+    import threading
+    import urllib.request
+
+    from pytorch_cifar_tpu.serve.loadgen import (
+        HttpTarget,
+        run_load,
+        zipf_mix,
+    )
+    from pytorch_cifar_tpu.serve.tenancy import load_cost_priors
+
+    zoo_models = ["LeNet", "MobileNet", "VGG11"]
+    ckpt_dir = os.path.join(work, "ckpt_lenet")
+    print(f"==> [zoo] training LeNet checkpoint -> {ckpt_dir}",
+          file=sys.stderr)
+    run_to_completion(train_cmd(args, ckpt_dir), child_env(), args.timeout)
+
+    env = child_env()
+    env.pop("XLA_FLAGS", None)  # replicas: production 1-device shape
+    models_arg = f"LeNet={ckpt_dir},MobileNet,VGG11"
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "router_run.py"),
+        "--ckpt", os.path.join(work, "nonexistent"),
+        "--models", models_arg,
+        "--max_resident", "2",
+        "--replicas", "2",
+        "--buckets", "1", "4",
+        "--aot_cache", os.path.join(work, "aot"),
+        "--deadline_ms", "4000",
+        "--probe_s", "0.2",
+        "--max_wait_ms", "1",
+    ]
+    print("==> [zoo] fleet up", file=sys.stderr)
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+    replica_re = re.compile(r"==> replica (\d+) pid=(\d+) url=(\S+)")
+    router_re = re.compile(r"==> router: serving on (\S+)")
+    replicas = {}
+    router_url = None
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"router_run exited rc={proc.returncode} before the "
+                    "router came up"
+                )
+            time.sleep(0.05)
+            continue
+        sys.stderr.write(line)
+        m = replica_re.search(line)
+        if m:
+            replicas[int(m.group(1))] = (int(m.group(2)), m.group(3))
+        m = router_re.search(line)
+        if m:
+            router_url = m.group(1)
+            break
+    if router_url is None or len(replicas) != 2:
+        proc.kill()
+        raise SystemExit("timed out waiting for the fleet topology")
+    drain_t = threading.Thread(
+        target=lambda: [sys.stderr.write(ln) for ln in proc.stderr],
+        name="zoo-stderr-drain", daemon=True,
+    )
+    drain_t.start()
+
+    def healthz(url):
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            return json.load(r)
+
+    # warm replica joined with zero compiles on every RESIDENT tenant
+    # (the shared AOT cache — replica 0 populated it)
+    h1 = healthz(replicas[1][1])
+    warm_compiles = sum(
+        int(t.get("compiles") or 0)
+        for t in h1.get("tenants", {}).values()
+        if t.get("resident")
+    )
+
+    # phase 1 — per-model bit-identity across the fleet, both wire
+    # encodings; touching all 3 models through 2 resident slots IS
+    # eviction churn, so identity holds across evict/re-admit too
+    probe = np.random.RandomState(7).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    pre_bits = {}
+    per_model_identical = {}
+    for model in zoo_models:
+        outs = [
+            HttpTarget(u, wire=w).submit(probe, model=model).result()
+            for u in (replicas[0][1], replicas[1][1], router_url)
+            for w in ("json", "binary")
+        ]
+        per_model_identical[model] = all(
+            np.array_equal(outs[0], o) for o in outs[1:]
+        )
+        pre_bits[model] = outs[0]
+    bit_identical = all(per_model_identical.values())
+
+    mix = zipf_mix(zoo_models, priors=load_cost_priors())
+
+    def load_phase(tag, duration_s, seed):
+        rep = run_load(
+            HttpTarget(router_url, wire="mixed"),
+            clients=4,
+            requests_per_client=10**6,
+            images_max=4,
+            seed=seed,
+            duration_s=duration_s,
+            bulk_fraction=0.3,
+            model_mix=mix,
+        )
+        print(
+            f"==> [zoo] {tag}: {rep['requests']} reqs "
+            f"per_model={rep['per_model']} p99={rep['p99_ms']:.1f}ms "
+            f"hedged={rep['hedged']} failed={rep['failed']}",
+            file=sys.stderr,
+        )
+        return rep
+
+    print("==> [zoo] phase 2: steady state", file=sys.stderr)
+    steady = load_phase("steady", 5.0, seed=1)
+
+    print("==> [zoo] phase 3: SIGKILL replica 0 under load",
+          file=sys.stderr)
+    kill_at = threading.Timer(
+        2.0, os.kill, (replicas[0][0], signal.SIGKILL)
+    )
+    kill_at.start()
+    t_kill = time.monotonic()
+    killed = load_phase("kill", 6.0, seed=2)
+    kill_at.join()
+    kill_recovery_s = time.monotonic() - t_kill
+
+    print("==> [zoo] phase 4: post-evict survivor audit", file=sys.stderr)
+    post = load_phase("post-evict", 4.0, seed=3)
+    h_survivor = healthz(replicas[1][1])
+    tenants = h_survivor.get("tenants", {})
+    # forced churn really happened: at least one tenant was evicted and
+    # re-admitted, and every CURRENTLY resident tenant that has been
+    # re-admitted cold-started from the cache (compiles == 0, hits > 0)
+    churned = [
+        n for n, t in tenants.items() if int(t.get("evictions") or 0) >= 1
+    ]
+    readmits_clean = all(
+        int(t.get("compiles") or 0) == 0
+        and int(t.get("aot_cache_hits") or 0) > 0
+        for n, t in tenants.items()
+        if t.get("resident") and int(t.get("evictions") or 0) >= 1
+    )
+    # post-kill, per-model router answers still match phase 1's bits
+    post_bits_ok = all(
+        np.array_equal(
+            HttpTarget(router_url).submit(probe, model=m).result(),
+            pre_bits[m],
+        )
+        for m in zoo_models
+    )
+    router_health = healthz(router_url)
+    healthy_after = int(router_health.get("healthy_replicas", -1))
+
+    print("==> [zoo] phase 5: drain", file=sys.stderr)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=args.timeout)
+    drain_t.join(timeout=10)
+    rec_run = None
+    for ln in out.splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                rec_run = json.loads(ln)
+            except ValueError:
+                continue
+    if rec_run is None:
+        raise SystemExit("router_run printed no JSON record")
+
+    total_failed = steady["failed"] + killed["failed"] + post["failed"]
+    ok = (
+        proc.returncode == 0
+        and warm_compiles == 0
+        and bit_identical
+        and post_bits_ok
+        and steady["requests"] > 0
+        and killed["requests"] > 0
+        and post["requests"] > 0
+        and total_failed == 0  # zero client-visible errors, all phases
+        and len(churned) >= 1  # the 3rd tenant forced real churn
+        and readmits_clean
+        and healthy_after == 1
+        and rec_run["router"]["evictions"] >= 1
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "zoo",
+        "match": ok,
+        "models": zoo_models,
+        "max_resident": 2,
+        "mix": {m: round(w, 4) for m, w in mix.items()},
+        "recovery_s": round(kill_recovery_s, 2),
+        "warm_replica_compiles": warm_compiles,
+        "per_model_bit_identical": per_model_identical,
+        "post_kill_bits_match": post_bits_ok,
+        "requests": steady["requests"] + killed["requests"]
+        + post["requests"],
+        "per_model_requests": {
+            m: steady["per_model"][m] + killed["per_model"][m]
+            + post["per_model"][m]
+            for m in zoo_models
+        },
+        "failed": total_failed,
+        "hedged_during_kill": killed["hedged"],
+        "churned_tenants": churned,
+        "readmit_compiles_zero": readmits_clean,
+        "survivor_tenants": {
+            n: {
+                "resident": t.get("resident"),
+                "admissions": t.get("admissions"),
+                "evictions": t.get("evictions"),
+                "compiles": t.get("compiles"),
+                "aot_cache_hits": t.get("aot_cache_hits"),
+            }
+            for n, t in tenants.items()
+        },
+        "healthy_after": healthy_after,
+        "evictions": rec_run["router"]["evictions"],
+        "router_hedged": rec_run["router"]["hedged"],
+        "router_rc": proc.returncode,
+    }
+
+
 def canary_drill(args, work: str) -> dict:
     """The promotion-pipeline drill (module docstring).
 
@@ -1055,7 +1323,7 @@ def main() -> int:
         "--mode",
         choices=(
             "sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt",
-            "router", "canary",
+            "router", "canary", "zoo",
         ),
         default="sigterm",
     )
@@ -1101,12 +1369,13 @@ def main() -> int:
 
     work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
 
-    if args.mode in ("serve", "ckpt", "router", "canary"):
+    if args.mode in ("serve", "ckpt", "router", "canary", "zoo"):
         record = {
             "serve": serve_drill,
             "ckpt": ckpt_drill,
             "router": router_drill,
             "canary": canary_drill,
+            "zoo": zoo_drill,
         }[args.mode](args, work)
         print(json.dumps(record))
         if record["match"] and not args.out:

@@ -220,11 +220,18 @@ def main() -> int:
                    "promotion (0 = golden-only gate)")
     p.add_argument("--max_flip_frac", type=float, default=0.75)
     p.add_argument("--acc_margin", type=float, default=1.0)
-    p.add_argument("--golden", choices=("eval", "random"), default="eval",
+    p.add_argument("--golden", choices=("eval", "labeled", "random"),
+                   default="eval",
                    help="golden set: the deterministic synthetic eval "
-                   "split (labeled: accuracy gate applies) or unlabeled "
-                   "random batches")
+                   "split (labeled: accuracy gate applies), 'labeled' = "
+                   "the REAL CIFAR-10 test split tools/accuracy_run.py "
+                   "evaluates on (GoldenSet.labeled_eval; falls back to "
+                   "synthetic loudly when the archive is absent), or "
+                   "unlabeled random batches")
     p.add_argument("--golden_n", type=int, default=128)
+    p.add_argument("--data_dir", default="./data",
+                   help="--golden labeled: where the CIFAR-10 archive "
+                   "lives")
     # load + lifecycle
     p.add_argument("--clients", type=int, default=0)
     p.add_argument("--images_max", type=int, default=4)
@@ -304,14 +311,19 @@ def main() -> int:
         live, args.model, buckets=tuple(args.buckets),
         compute_dtype=jnp.float32,
     )
-    golden = (
-        GoldenSet.synthetic_eval(
+    if args.golden == "eval":
+        golden = GoldenSet.synthetic_eval(
             n_train=args.train_size, n_test=args.test_size,
             limit=args.golden_n,
         )
-        if args.golden == "eval"
-        else GoldenSet.random(args.golden_n, seed=args.seed)
-    )
+    elif args.golden == "labeled":
+        # the accuracy-run eval path as the canary gate (ROADMAP
+        # standing item): budgets judge REAL labeled accuracy
+        golden = GoldenSet.labeled_eval(
+            args.data_dir, limit=args.golden_n, seed=args.seed
+        )
+    else:
+        golden = GoldenSet.random(args.golden_n, seed=args.seed)
     controller = PromotionController(
         canary_engine, staging, live,
         golden=golden,

@@ -109,6 +109,12 @@ def spawn_replica(args, idx: int) -> ReplicaProc:
     ]
     if args.aot_cache:
         cmd += ["--aot_cache", args.aot_cache]
+    if args.models:
+        # multi-tenant zoo replicas (SERVING.md "Multi-tenant zoo
+        # serving"): every replica hosts the same tenant list; the
+        # router dispatches model-aware off each replica's /healthz
+        cmd += ["--models", args.models,
+                "--max_resident", str(args.max_resident)]
     if args.watch:
         cmd.append("--watch")
     env = dict(os.environ)
@@ -205,6 +211,18 @@ def main() -> int:
         help="shared AOT executable cache dir: replica 0 populates it, "
         "later replicas cold-start with compile_count == 0",
     )
+    p.add_argument(
+        "--models", default="",
+        help="multi-tenant zoo fleet: comma-separated "
+        "'Name[=ckpt_dir]' tenant list passed to every replica "
+        "(serve.py --models); the built-in loadgen then draws a "
+        "heavy-tailed per-model mix",
+    )
+    p.add_argument(
+        "--max_resident", type=int, default=0,
+        help="per-replica resident-tenant bound (0 = all resident); "
+        "forces placement churn below the tenant count",
+    )
     p.add_argument("--watch", action="store_true")
     p.add_argument("--poll_s", type=float, default=1.0)
     p.add_argument("--probe_s", type=float, default=0.5)
@@ -262,6 +280,18 @@ def main() -> int:
     report = {}
     try:
         if args.clients > 0:
+            model_mix = None
+            if args.models:
+                from pytorch_cifar_tpu.serve.loadgen import zipf_mix
+                from pytorch_cifar_tpu.serve.tenancy import (
+                    load_cost_priors,
+                )
+
+                names = [
+                    e.split("=", 1)[0].strip()
+                    for e in args.models.split(",")
+                ]
+                model_mix = zipf_mix(names, priors=load_cost_priors())
             target = HttpTarget(frontend.url)
             report = run_load(
                 target,
@@ -271,6 +301,7 @@ def main() -> int:
                 seed=args.seed,
                 duration_s=args.duration_s or None,
                 bulk_fraction=args.bulk_fraction,
+                model_mix=model_mix,
             )
         else:
             stop = threading.Event()
@@ -287,6 +318,7 @@ def main() -> int:
         "harness": "router_run",
         "replicas": args.replicas,
         "model": args.model,
+        "models": args.models,
         "router_url": frontend.url,
         "replica_compiles": [h.get("compiles") for h in healths],
         "replica_aot_hits": [h.get("aot_cache_hits") for h in healths],
