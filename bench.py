@@ -1201,6 +1201,153 @@ def chaos_smoke(args) -> int:
     return 0
 
 
+def serve_mesh_bench(args) -> int:
+    """``--serve-mesh``: the cross-host serving A/B (SERVING.md
+    "Multi-process mesh replica"). Spawns a 2-PROCESS logical replica
+    (leader + follower serve.py ranks over a shared gloo mesh, one
+    forced CPU device per rank) and a SINGLE-HOST process over the same
+    global device count, drives the built-in closed loop against each,
+    and reports:
+
+    - ``value`` = the WARM mesh replica's img/s (the steady state an
+      autoscaled replica actually serves at),
+    - ``mesh_vs_single`` = mesh / single-host throughput at equal global
+      devices (on one CPU core this prices the broadcast+allgather
+      coordination tax; on real multi-host hardware it prices DCN),
+    - the warm-start pin: the second mesh launch imports every bucket
+      program from the topology-aware AOT cache — ``warm_compiles`` must
+      be [0, 0] (leader, follower) with a full set of verified hits.
+
+    Like headline()/chaos_smoke(), this parent never initializes a jax
+    backend — the serve children own the devices."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="bench_mesh_")
+
+    def env_with_devices(n):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        env.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
+        return env
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def last_json(stdout):
+        rec = None
+        for ln in stdout.splitlines():
+            s = ln.strip()
+            if s.startswith("{"):
+                try:
+                    rec = json.loads(s)
+                except ValueError:
+                    continue
+        return rec
+
+    ckpt = os.path.join(work, "ckpt")
+    print(f"==> [mesh] training tiny checkpoint -> {ckpt}", file=sys.stderr)
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(here, "train.py"),
+            "--model", args.model, "--synthetic_data",
+            "--synthetic_train_size", "256", "--synthetic_test_size", "64",
+            "--batch_size", "64", "--epochs", "1", "--output_dir", ckpt,
+            "--async_save", "off",
+        ],
+        env=env_with_devices(1), capture_output=True, text=True,
+        timeout=900, cwd=here,
+    )
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise SystemExit("mesh bench: training the checkpoint failed")
+
+    requests = max(8, args.steps * 4)
+    serve_base = [
+        sys.executable, os.path.join(here, "serve.py"),
+        "--ckpt", ckpt, "--model", args.model,
+        "--buckets", "1", "4", "8", "--dtype", args.dtype,
+        "--clients", "4", "--requests", str(requests),
+        "--max_wait_ms", "1",
+    ]
+
+    def run_mesh(tag):
+        coord = f"127.0.0.1:{free_port()}"
+        mesh_flags = [
+            "--mesh_procs", "2", "--mesh_coord", coord,
+            "--mesh_timeout_s", "60",
+            "--aot_cache", os.path.join(work, "aot"),
+        ]
+        print(f"==> [mesh] {tag} 2-process replica run", file=sys.stderr)
+        procs = [
+            subprocess.Popen(
+                serve_base + mesh_flags + ["--mesh_rank", str(rank)],
+                env=env_with_devices(1), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True, cwd=here,
+            )
+            for rank in (0, 1)
+        ]
+        recs = []
+        for p in procs:
+            out, err = p.communicate(timeout=900)
+            if p.returncode != 0:
+                sys.stderr.write(err[-3000:])
+                raise SystemExit(f"mesh bench: {tag} rank failed")
+            recs.append(last_json(out))
+        return recs  # [leader record, follower record]
+
+    cold_lead, cold_fol = run_mesh("cold")
+    warm_lead, warm_fol = run_mesh("warm")
+
+    print("==> [mesh] single-host comparator run", file=sys.stderr)
+    r = subprocess.run(
+        serve_base,
+        env=env_with_devices(2), capture_output=True, text=True,
+        timeout=900, cwd=here,
+    )
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise SystemExit("mesh bench: single-host comparator failed")
+    single = last_json(r.stdout)
+
+    value = float(warm_lead["img_per_sec"])
+    rec = core_record(
+        f"serve_mesh_2proc_{args.model}_{args.dtype}_cpu",
+        value, unit="images/sec",
+    )
+    rec.update(
+        mesh_procs=2,
+        n_devices=warm_lead["n_devices"],
+        mesh=warm_lead["mesh"],
+        p50_ms=warm_lead["p50_ms"],
+        p95_ms=warm_lead["p95_ms"],
+        p99_ms=warm_lead["p99_ms"],
+        requests=warm_lead["requests"],
+        failed=warm_lead["failed"],
+        single_img_per_sec=round(float(single["img_per_sec"]), 2),
+        single_n_devices=single["n_devices"],
+        mesh_vs_single=round(
+            value / max(float(single["img_per_sec"]), 1e-9), 4
+        ),
+        # the warm-start acceptance pin, PER PROCESS [leader, follower]
+        cold_compiles=[cold_lead["compiles"], cold_fol["compiles"]],
+        warm_compiles=[warm_lead["compiles"], warm_fol["compiles"]],
+        warm_aot_hits=[
+            warm_lead["aot_cache_hits"], warm_fol["aot_cache_hits"]
+        ],
+        cold_start_s=warm_lead["cold_start_s"],
+    )
+    print(json.dumps(rec))
+    shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
 def headline(args) -> int:
     """The default scoreboard protocol: median of ``--captures`` fresh
     subprocess runs of the production epoch path, plus one ``--step``
@@ -1345,6 +1492,15 @@ def main() -> int:
         "p50/p95/p99 + img/s + http_vs_inproc in the single-line record",
     )
     parser.add_argument(
+        "--serve-mesh", action="store_true", dest="serve_mesh",
+        help="measure cross-host serving (serve/mesh_replica.py, "
+        "SERVING.md 'Multi-process mesh replica'): a 2-process logical "
+        "replica vs a single-host process at equal global devices "
+        "(mesh_vs_single), plus the warm-start pin — the second mesh "
+        "launch must import every bucket program from the "
+        "topology-aware AOT cache with zero compiles on every rank",
+    )
+    parser.add_argument(
         "--serve-zoo", action="store_true", dest="serve_zoo",
         help="measure multi-tenant zoo serving (serve/tenancy.py, "
         "SERVING.md 'Multi-tenant zoo serving'): per-model img/s under "
@@ -1387,6 +1543,10 @@ def main() -> int:
     if args.chaos_smoke:
         # never touches a jax backend in this process (children own it)
         return chaos_smoke(args)
+
+    if args.serve_mesh:
+        # multi-process orchestration: the serve ranks own the devices
+        return serve_mesh_bench(args)
 
     if not (
         args.pipeline

@@ -251,6 +251,23 @@ class ServeConfig:
     mean: Tuple[float, float, float] = (0.4914, 0.4822, 0.4465)
     std: Tuple[float, float, float] = (0.2023, 0.1994, 0.2010)
 
+    # cross-host serving (SERVING.md "Multi-process mesh replica"):
+    # mesh_procs > 1 makes this invocation ONE RANK of a logical replica
+    # whose device mesh spans that many processes. Rank 0 (the leader)
+    # owns the HTTP frontend / micro-batcher and broadcasts every formed
+    # batch, weight swap, and shutdown; ranks > 0 run the lock-step
+    # follower loop on their main thread and print a small JSON record
+    # at drain. mesh_coord is the jax.distributed coordinator address
+    # (host:port) every rank must share; mesh_timeout_s bounds dead-peer
+    # detection — a rank stuck at a collective longer than this exits
+    # non-zero (rc 70) instead of hanging, which is what lets the router
+    # evict the logical replica. 1 = single-process serving, exactly as
+    # before.
+    mesh_procs: int = 1
+    mesh_rank: int = 0
+    mesh_coord: str = ""
+    mesh_timeout_s: float = 60.0
+
     # micro-batcher: coalesce up to max_batch images per dispatch, waiting
     # at most max_wait_ms after the first queued request; admission
     # control rejects once max_queue images are waiting (backpressure)

@@ -635,8 +635,10 @@ def put_sharded_array(
     process holds the same global array; each contributes only its
     contiguous slab and the global array is assembled from process-local
     shards. Shared by the eval/serve paths (``put_global`` and the
-    serving engine's sharded batch put) so the multi-process assembly
-    arithmetic lives in exactly one place.
+    serving engine's sharded batch put — including the multi-process
+    mesh replica's batch ingestion, where every rank holds the full
+    broadcast batch and contributes its slab; serve/mesh_replica.py) so
+    the multi-process assembly arithmetic lives in exactly one place.
     """
     if jax.process_count() > 1:
         (r0, r1), (h0, h1) = local_slab(sharding, x.shape)

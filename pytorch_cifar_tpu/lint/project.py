@@ -90,6 +90,26 @@ HOT_SEEDS: Sequence[Tuple[str, frozenset]] = (
 
 _THREAD_CTORS = ("threading.Thread", "Thread")
 
+# sanctioned collective-thread entries (STATIC_ANALYSIS.md
+# "thread-collective"): a module may declare, at top level,
+#
+#   GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES = {
+#       "Class.method": "why a collective on this thread is safe",
+#   }
+#
+# naming a def in the SAME module. A declared entry is removed from the
+# thread-reachability seeds, so collectives inside it — and in helpers
+# reachable ONLY through it — stop firing; everything reachable from any
+# UNDECLARED Thread target still fires, including helpers the sanctioned
+# entry shares with one. The reason is mandatory (same policy as noqa),
+# and a declaration naming a def the module does not define is itself a
+# finding — a rename cannot silently widen the sanction. The intended
+# (and only current) legitimate shape is a single-initiator lock-step
+# protocol loop: exactly one thread in the whole job starts collectives,
+# peers are pure responders on their main thread (the serve mesh
+# replica's dispatch loop).
+_SANCTION_DECL = "GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES"
+
 # where the real package lives (this file is pytorch_cifar_tpu/lint/...):
 # the on-demand fallback root for imports of modules outside the linted set
 _LINT_REPO_ROOT = os.path.dirname(
@@ -480,6 +500,8 @@ class ProjectGraph:
         self._edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
         self._node_of: Dict[Tuple[str, str], ast.AST] = {}
         self._thread_entries: List[Tuple[str, str, str]] = []
+        self._sanctioned: Dict[Tuple[str, str], str] = {}
+        self._sanction_issues: Dict[str, List[Tuple[ast.AST, str]]] = {}
         self._tracer_wrapper_cache: Dict[int, bool] = {}
         # snapshot: resolution may fault in external modules mid-loop
         for m in list(self.by_path.values()):
@@ -618,7 +640,67 @@ class ProjectGraph:
                 )
         return None
 
+    def _collect_sanctions(self, m: ModuleInfo) -> None:
+        """Parse a module's _SANCTION_DECL (see its comment above):
+        well-formed entries land in ``_sanctioned``; malformed ones —
+        non-dict value, non-string key/reason, empty reason, a key
+        naming no def in this module — become per-module issues the
+        thread-collective rule reports as findings."""
+        issues = self._sanction_issues.setdefault(m.path, [])
+        for stmt in m.tree.body:
+            if not (
+                isinstance(stmt, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == _SANCTION_DECL
+                    for t in stmt.targets
+                )
+            ):
+                continue
+            if not isinstance(stmt.value, ast.Dict):
+                issues.append(
+                    (stmt, f"{_SANCTION_DECL} must be a literal dict of "
+                     "{'def name': 'reason'}")
+                )
+                continue
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not (
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                ):
+                    issues.append(
+                        (k or stmt, f"{_SANCTION_DECL} keys must be "
+                         "string def names")
+                    )
+                    continue
+                if k.value not in m.defs:
+                    issues.append(
+                        (k, f"{_SANCTION_DECL} names {k.value!r}, which "
+                         f"this module does not define — stale after a "
+                         f"rename? (the sanction would silently widen)")
+                    )
+                    continue
+                if not (
+                    isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                    and v.value.strip()
+                ):
+                    issues.append(
+                        (v if v is not None else k,
+                         f"{_SANCTION_DECL} entry {k.value!r} has no "
+                         "reason — sanctioning a collective thread "
+                         "entry requires stating WHY the lock-step "
+                         "protocol makes it safe (same policy as noqa)")
+                    )
+                    continue
+                self._sanctioned[(m.path, k.value)] = v.value
+
+    def sanction_issues_for(self, path: str) -> List[Tuple[ast.AST, str]]:
+        """Malformed/stale sanction declarations in ``path`` (findings
+        for the thread-collective rule)."""
+        self._analyze()
+        return self._sanction_issues.get(os.path.abspath(path), [])
+
     def _analyze_module(self, m: ModuleInfo) -> None:
+        self._collect_sanctions(m)
         parents: Dict[ast.AST, ast.AST] = {}
         for node in ast.walk(m.tree):
             for child in ast.iter_child_nodes(node):
@@ -726,11 +808,17 @@ class ProjectGraph:
     def thread_reachable_for(self, path: str) -> Dict[ast.AST, str]:
         """{def node in ``path``: thread-entry label} for every def
         reachable from a ``Thread(target=...)`` entry anywhere in the
-        linted tree."""
+        linted tree. Entries declared in a module's _SANCTION_DECL are
+        excluded from the seeds — their closures are sanctioned — but a
+        def also reachable from an UNDECLARED thread entry still
+        appears (under-approximation never widens: the sanction removes
+        one entry's taint, not a shared helper's)."""
         self._analyze()
         if getattr(self, "_thread_reach", None) is None:
             reach: Dict[Tuple[str, str], str] = {}
             for epath, ekey, label in self._thread_entries:
+                if (epath, ekey) in self._sanctioned:
+                    continue
                 for nk in self._closure({(epath, ekey)}):
                     reach.setdefault(nk, label)
             self._thread_reach = reach

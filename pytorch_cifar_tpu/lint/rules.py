@@ -1442,12 +1442,22 @@ class ThreadCollective(Rule):
         "barrier ...) is reachable from a Thread(target=...) entry — a "
         "background thread makes per-process timing decisions, so its "
         "collective can strand every peer at the barrier (the async "
-        "checkpoint writer's multihost supersede bug shape)"
+        "checkpoint writer's multihost supersede bug shape). A module "
+        "may declare GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES "
+        "{'Cls.method': 'reason'} for a single-initiator lock-step "
+        "protocol loop (the mesh replica dispatch shape) — the declared "
+        "entry's closure is exempt, anything reachable from any OTHER "
+        "thread entry still fires, and a reasonless or stale "
+        "declaration is itself a finding"
     )
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         reach = ctx.project.thread_reachable(ctx.path)
         out = []
+        # malformed/stale sanction declarations (unknown def, missing
+        # reason): same mandatory-reason policy as inline noqa
+        for node, message in ctx.project.sanction_issues(ctx.path):
+            out.append(self.finding(ctx, node, message))
         for fn, entry in reach.items():
             if not isinstance(fn, FuncNode):
                 continue

@@ -141,6 +141,14 @@ def broadcast_pytree(tree, chunk_bytes: int = _BROADCAST_CHUNK_BYTES):
     byte buffer and broadcast in fixed-size chunks instead: many small
     uniform transfers where the one-shot path crashes the process inside
     gloo. Single-process: the tree comes back unchanged.
+
+    This is also the wire of the multi-process mesh replica's serving
+    protocol (serve/mesh_replica.py): command frames, batch payloads,
+    and weight swaps all ride it — callers there hold the additional
+    single-initiator discipline (exactly one thread in the job starts
+    broadcasts, in a total order) that makes it safe off the main
+    thread, which the thread-collective lint rule's sanctioned-entry
+    declaration records (STATIC_ANALYSIS.md).
     """
     if jax.process_count() == 1:
         return tree

@@ -42,6 +42,14 @@ becomes a long-lived prediction service:
   admission queues/SLOs/hot-reload/canary, and model-id routing through
   the frontend (JSON ``model`` field / wire-v2 frame field) and the
   router (SERVING.md "Multi-tenant zoo serving").
+- :mod:`~pytorch_cifar_tpu.serve.mesh_replica` is cross-host serving:
+  a :class:`~pytorch_cifar_tpu.serve.mesh_replica.MeshReplica` presents
+  an engine whose mesh spans N PROCESSES to the router as one logical
+  replica — the leader owns the frontend/batcher and broadcasts every
+  formed batch, weight swap, and shutdown to lock-step follower loops;
+  construction runs a distributed warmup barrier so no process serves
+  ahead of a straggler, and watchdogs bound dead-peer detection
+  (SERVING.md "Multi-process mesh replica").
 - :mod:`~pytorch_cifar_tpu.serve.canary` closes the train→serve loop:
   a :class:`~pytorch_cifar_tpu.serve.canary.PromotionController` vets
   every checkpoint a ``--publish staging`` trainer commits — golden-batch
@@ -73,6 +81,10 @@ from pytorch_cifar_tpu.serve.engine import (  # noqa: F401
 from pytorch_cifar_tpu.serve.frontend import (  # noqa: F401
     BatcherBackend,
     ServingFrontend,
+)
+from pytorch_cifar_tpu.serve.mesh_replica import (  # noqa: F401
+    MeshReplica,
+    MeshReplicaError,
 )
 from pytorch_cifar_tpu.serve.reload import CheckpointWatcher  # noqa: F401
 from pytorch_cifar_tpu.serve.router import Router  # noqa: F401

@@ -566,7 +566,16 @@ class MicroBatcher:
         admitted before the worker exits. ``drain=False`` fails all
         pending futures immediately; a worker that misses ``timeout``
         (stalled engine call) has its remaining queue failed too — either
-        way no caller stays blocked forever on ``future.result()``."""
+        way no caller stays blocked forever on ``future.result()``.
+
+        An engine that can wedge on a DEAD PEER — the multi-process mesh
+        replica, whose dispatch blocks in a collective until its
+        watchdog kills the process (serve/mesh_replica.py) — advertises
+        ``drain_timeout_s``; with no explicit ``timeout`` the join is
+        bounded by that instead of waiting forever on a worker whose
+        process is about to exit under it."""
+        if timeout is None:
+            timeout = getattr(self.engine, "drain_timeout_s", None)
         with self._cond:
             self._closed = True
             self._drain = drain

@@ -261,6 +261,17 @@ class InferenceEngine:
         # replicated. mesh=None keeps the exact single-device path.
         self.mesh = mesh
         self._singleton = None
+        # multi-process mesh replica (SERVING.md "Multi-process mesh
+        # replica"): the mesh spans several processes, so batch-sharded
+        # outputs are no longer fully addressable — logits come back via
+        # a host allgather (_fetch_batch_out) and every executable call
+        # is a COLLECTIVE all processes must enter in the same order
+        # (serve/mesh_replica.py owns that ordering).
+        import jax
+
+        self._multiprocess = (
+            mesh is not None and jax.process_count() > 1
+        )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -460,6 +471,24 @@ class InferenceEngine:
             for p, v in jax.tree_util.tree_leaves_with_path(tree)
         ]
 
+    def check_swap_avals(self, params, batch_stats) -> None:
+        """Raise ValueError unless ``(params, batch_stats)`` match the
+        RAW avals the compiled programs were built against — the exact
+        precondition of :meth:`swap_weights`. Public so a coordinator
+        (serve/mesh_replica.py) can reject a wrong-model checkpoint on
+        the CALLER's thread, before the trees are broadcast to peer
+        processes."""
+        raw_p, raw_s = self._raw_avals
+        for old, new, kind in (
+            (raw_p, params, "params"),
+            (raw_s, batch_stats or {}, "batch_stats"),
+        ):
+            if old != self._avals(new):
+                raise ValueError(
+                    f"refusing weight swap: new {kind} tree does not match "
+                    f"the compiled program's avals (different model/config?)"
+                )
+
     def swap_weights(self, params, batch_stats) -> int:
         """Atomically replace the served weights; returns the new version.
 
@@ -472,16 +501,7 @@ class InferenceEngine:
         construction — an int8 engine still takes (and re-quantizes) the
         same float trees a checkpoint loads.
         """
-        raw_p, raw_s = self._raw_avals
-        for old, new, kind in (
-            (raw_p, params, "params"),
-            (raw_s, batch_stats or {}, "batch_stats"),
-        ):
-            if old != self._avals(new):
-                raise ValueError(
-                    f"refusing weight swap: new {kind} tree does not match "
-                    f"the compiled program's avals (different model/config?)"
-                )
+        self.check_swap_avals(params, batch_stats)
         # fetch/quantize/put OUTSIDE the lock (graftcheck
         # blocking-under-lock: a D2H stall here would freeze every
         # contending swapper); the critical section is two assignments
@@ -548,6 +568,22 @@ class InferenceEngine:
             "n_devices": int(self.n_devices),
             "mesh": list(self.mesh.devices.shape) if self.mesh is not None
             else None,
+            # mesh topology (SERVING.md "Multi-process mesh replica"): a
+            # serialized executable embeds its process/device assignment,
+            # so the fingerprint carries the process span, THIS process's
+            # rank, and the global device→process map — entries are
+            # per-process, and a replica relaunched on a different
+            # topology can never import a stale program under the old key
+            "process_count": int(jax.process_count()),
+            "process_index": int(jax.process_index()),
+            "devices": [
+                f"p{d.process_index}:{d.id}"
+                for d in (
+                    self.mesh.devices.flat
+                    if self.mesh is not None
+                    else jax.devices()[:1]
+                )
+            ],
             "platform": jax.devices()[0].platform,
             "jax": jax.__version__,
             "jaxlib": jaxlib.__version__,
@@ -616,11 +652,46 @@ class InferenceEngine:
             tree = replicate(jax.device_get(tree), self.mesh)
         return tree
 
-    def _run_probe(self, exe, weights, x: np.ndarray) -> np.ndarray:
-        import jax
+    def _fetch_batch_out(self, out) -> np.ndarray:
+        """Host logits of one bucket call's batch-sharded output.
 
+        Single-process: a plain ``np.asarray`` (the PR 1 path, byte for
+        byte). Multi-process: each process holds only its own shards, so
+        the local rows (assembled in device order) ride a host allgather
+        — uniform size per bucket program, the gloo-safe shape — and
+        every process gets the full batch back. The COMPUTATION is the
+        same batch-sharded program the single-process mesh engine runs
+        (pinned bit-identical to single-device); only the fetch differs.
+        This makes every bucket call a collective: all processes of the
+        mesh must enter it in the same order (serve/mesh_replica.py)."""
+        if not self._multiprocess:
+            return np.asarray(out)
+        from jax.experimental import multihost_utils
+
+        shards = sorted(
+            out.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        local = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+        gathered = np.asarray(multihost_utils.process_allgather(local))
+        return gathered.reshape(-1, *local.shape[1:])
+
+    def _run_probe(self, exe, weights, x: np.ndarray) -> np.ndarray:
         p, s = weights
-        return np.asarray(jax.device_get(exe(p, s, self._put_batch(x))))
+        return self._fetch_batch_out(exe(p, s, self._put_batch(x)))
+
+    def _agree_flags(self, flags) -> np.ndarray:
+        """Cross-process AND of a small per-process flag vector: the
+        element-wise minimum over every process's value (identity under
+        one process). Uniform fixed-size payload, so the allgather is
+        gloo-safe (the obs merge precedent, OBSERVABILITY.md)."""
+        flags = np.asarray(flags, np.int64)
+        if not self._multiprocess:
+            return flags
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(flags)
+        ).min(axis=0)
 
     def _import_cached(self, cache_dir: str) -> dict:
         """Verified executables from the AOT cache, keyed by bucket.
@@ -632,7 +703,23 @@ class InferenceEngine:
         bit-for-bit under canonical weights, and ONE bucket (the smallest
         imported) is additionally checked against a freshly compiled
         reference. Any refuted entry is marked poisoned and the whole
-        cache load is dropped — the engine compiles instead."""
+        cache load is dropped — the engine compiles instead.
+
+        Multi-process mesh (SERVING.md "Multi-process mesh replica"):
+        every probe execution is a COLLECTIVE, so the processes must
+        agree on which buckets to probe before any probe runs. The
+        protocol is a fixed collective sequence every process executes
+        identically, branching only on GLOBAL facts: (1) local scan —
+        read + deserialize this process's own per-topology entries, no
+        execution; (2) agreement allgather — a bucket is a candidate
+        only if EVERY process holds a verifiable entry for it; (3) probe
+        the agreed buckets in ascending order, each a collective call
+        verified per process against its OWN export-time expectation;
+        (4) verdict allgather — a probe refuted on ANY process drops the
+        whole load on ALL of them (stricter than the single-process
+        per-entry drop: a half-trusted import set would mean processes
+        serving different executables); (5) the fresh-reference check on
+        the smallest agreed bucket, cross-checked the same way."""
         from pytorch_cifar_tpu.serve import aot_cache
 
         def miss(n: int = 1):
@@ -640,10 +727,10 @@ class InferenceEngine:
             if self._obs is not None:
                 self._obs.counter("serve.aot_cache_misses").inc(n)
 
+        # phase 1: local scan — no execution, so per-process divergence
+        # here (a torn entry on one host) cannot desync the collectives
         candidates: dict = {}
-        probe_out: dict = {}
         names: dict = {}
-        probe_weights = None
         for b in self.buckets:
             if b in self._compiled:
                 continue
@@ -664,33 +751,73 @@ class InferenceEngine:
                 )
                 miss()
                 continue
-            if probe_weights is None:
-                probe_weights = self._probe_weights()
+            candidates[b] = (exe, np.asarray(entry["probe_logits"]))
+            names[b] = name
+        # phase 2: cross-process agreement on the candidate set
+        if self._multiprocess:
+            avail = self._agree_flags(
+                [1 if b in candidates else 0 for b in self.buckets]
+            )
+            for b, ok in zip(self.buckets, avail):
+                if not ok and b in candidates:
+                    log.info(
+                        "AOT cache bucket %d present here but missing on "
+                        "a peer process — compiling everywhere", b,
+                    )
+                    candidates.pop(b)
+                    names.pop(b)
+                    miss()
+        if not candidates:
+            # globally consistent: the agreement above already ensures
+            # every process sees the same (empty) candidate set
+            return {}
+        # phase 3: probe the agreed buckets in ascending order (each a
+        # collective under multi-process)
+        probe_weights = self._probe_weights()
+        probe_out: dict = {}
+        verdicts = []
+        for b in sorted(candidates):
+            exe, expect = candidates[b]
             got = self._run_probe(exe, probe_weights, self._probe_batch(b))
-            if not np.array_equal(got, np.asarray(entry["probe_logits"])):
+            ok = np.array_equal(got, expect)
+            if not ok:
                 aot_cache.poison_entry(
-                    cache_dir, name,
+                    cache_dir, names[b],
                     "probe logits differ from export-time expectation",
                 )
-                miss()
-                continue
-            candidates[b] = exe
             probe_out[b] = got
-            names[b] = name
-        if not candidates:
+            verdicts.append(1 if ok else 0)
+        # phase 4: verdict agreement
+        agreed = self._agree_flags(verdicts)
+        if self._multiprocess and not agreed.all():
+            # a peer (or this process) refuted an entry: drop the load
+            # everywhere — a partial import would leave the processes
+            # serving different executables for the same bucket set
+            miss(len(candidates))
             return {}
+        if not self._multiprocess:
+            for b, ok in zip(sorted(candidates), verdicts):
+                if not ok:
+                    candidates.pop(b)
+                    names.pop(b)
+                    miss()
+            if not candidates:
+                return {}
+        # phase 5: one bucket against a freshly compiled reference
         b0 = min(candidates)
         ref = self._compile_bucket(b0, count=False)
         ref_logits = self._run_probe(
             ref, probe_weights, self._probe_batch(b0)
         )
-        if not np.array_equal(ref_logits, probe_out[b0]):
+        ref_ok = np.array_equal(ref_logits, probe_out[b0])
+        if not ref_ok:
             aot_cache.poison_entry(
                 cache_dir, names[b0],
                 "deserialized executable diverges from a freshly "
                 "compiled reference (jaxlib deserialization bug class — "
                 "ROBUSTNESS.md)",
             )
+        if not self._agree_flags([1 if ref_ok else 0]).all():
             # one refuted import invalidates the whole load: the stored
             # expectations came from the same exporter
             miss(len(candidates))
@@ -698,7 +825,7 @@ class InferenceEngine:
         self.aot_cache_hits += len(candidates)
         if self._obs is not None:
             self._obs.counter("serve.aot_cache_hits").inc(len(candidates))
-        return candidates
+        return {b: exe for b, (exe, _) in candidates.items()}
 
     def warmup(self, cache_dir: Optional[str] = None) -> None:
         """AOT-compile every bucket program (idempotent). After this, no
@@ -710,14 +837,20 @@ class InferenceEngine:
         imported instead of recompiled — a warm replica cold-starts in
         load time with ``compile_count == 0`` — and whatever had to be
         compiled is exported for the next replica. Cache entries are
-        verified by probe before use (see :meth:`_import_cached`);
-        multi-process serving skips the cache (executables embed the
-        local process topology)."""
-        import jax
+        verified by probe before use (see :meth:`_import_cached`).
 
+        Multi-process mesh (SERVING.md "Multi-process mesh replica"):
+        the cache works per process — each process imports/exports
+        entries under its OWN topology-aware fingerprint (process count,
+        rank, global device assignment in :meth:`_cache_key_fields`) —
+        and every probe/verification execution is a collective, so all
+        processes must call warmup concurrently in the same order (the
+        mesh replica construction path guarantees this). The import set
+        is cross-checked for agreement before use: a bucket is imported
+        only when EVERY process holds a verified entry for it."""
         t0 = time.perf_counter()
         cache_dir = cache_dir if cache_dir is not None else self.aot_cache_dir
-        use_cache = bool(cache_dir) and jax.process_count() == 1
+        use_cache = bool(cache_dir)
         imported = self._import_cached(cache_dir) if use_cache else {}
         probe_weights = None
         for b in self.buckets:
@@ -818,7 +951,7 @@ class InferenceEngine:
             with trace.span("serve/bucket_forward", bucket=b, n=n):
                 out = self._compiled[b](params, stats, self._put_batch(x))
                 # graftcheck: noqa[host-sync] -- the ONE sanctioned D2H sync of the dispatch path: callers receive host logits, so this fetch IS the result (everything upstream stays async)
-                res = np.asarray(out)[:n]  # D2H: waits for the execution
+                res = self._fetch_batch_out(out)[:n]  # D2H: waits for the execution
         finally:
             if staged is not None:
                 self.staging.release(staged)

@@ -265,6 +265,13 @@ class BatcherBackend:
             out["reloads"] = self.watcher.reloads
             out["reload_skipped"] = self.watcher.skipped
             out["reload_quarantined"] = self.watcher.quarantined
+        # multi-process mesh replica (SERVING.md): surface the process
+        # topology + warmup-barrier generation so a probe can tell a
+        # fully-joined replica from a half-joined one (tools/router_run
+        # waits on this; ops debug from it)
+        mesh_health = getattr(eng, "mesh_health", None)
+        if mesh_health is not None:
+            out["mesh"] = mesh_health()
         return out
 
 

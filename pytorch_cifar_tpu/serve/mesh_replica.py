@@ -1,0 +1,657 @@
+"""Cross-host serving: one logical replica spanning N processes.
+
+A ``router_run`` fleet used to be single-host replicas only, so the
+largest servable model (and one replica's batch-throughput ceiling) was
+capped by one host. This module presents an :class:`InferenceEngine`
+whose mesh spans ``jax.process_count()`` processes to the router as ONE
+logical replica (SERVING.md "Multi-process mesh replica"):
+
+- **Process 0 (the leader)** owns the HTTP frontend and the
+  micro-batcher. Every formed batch is broadcast to the followers —
+  first a fixed-size command frame (op, row count), then the batch
+  bytes — over :func:`~pytorch_cifar_tpu.parallel.mesh.broadcast_pytree`
+  (the gloo-safe uniform-chunk path), and all processes then enter the
+  SAME sharded bucket program, ingesting the batch through the train
+  pipeline's ``put_sharded_array``. The logits come back through the
+  engine's host allgather, and the leader answers the wire.
+- **Followers (ranks > 0)** run :meth:`MeshReplica.follower_loop` on
+  their MAIN thread: a lock-step responder that blocks on the next
+  command broadcast and mirrors whatever the leader dispatched. A
+  follower makes no timing decision of its own — the whole protocol has
+  exactly ONE collective initiator, the leader's dispatch thread.
+- **Single initiator, total order.** All collectives (batches, weight
+  swaps, heartbeats, shutdown) are issued by one leader thread,
+  ``_dispatch_loop``; callers (batcher worker, hot-reload watcher)
+  enqueue work and wait on a Future. This is what makes a collective on
+  a background thread safe here — and it is declared to graftcheck via
+  ``GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES`` below rather than
+  suppressed (STATIC_ANALYSIS.md "thread-collective").
+- **Bootstrap + distributed warmup barrier.** Construction broadcasts
+  the leader's weights to every process (bit-identical serving state by
+  construction, whatever each process loaded from disk), then runs a
+  collective rendezvous per bucket: every process executes the
+  canonical probe batch through its compiled program and must match the
+  leader's logits bit-for-bit. No process can serve (or report healthy)
+  ahead of a straggler still compiling — the probe call blocks until
+  every peer arrives.
+- **Hot reload / swap.** ``swap_weights`` validates avals on the
+  caller's thread, then the dispatch loop broadcasts the trees and every
+  process swaps the same generation atomically (``engine.version``
+  advances in lock-step; a wrong-model checkpoint is rejected before
+  anything is broadcast).
+- **Bounded dead-peer detection, never a hang — and never a zombie.**
+  A dead peer surfaces in one of two ways, and both are terminal:
+  (a) the collective HANGS — gloo waits for a peer that will never
+  arrive; it cannot be interrupted from Python, so each side runs a
+  watchdog (the leader arms a deadline around every collective, a
+  follower re-arms on every received command while the idle leader
+  broadcasts heartbeats) that exits :data:`PEER_TIMEOUT_RC` within
+  ``timeout_s``; or (b) the collective RAISES — gloo's TCP transport
+  noticed the reset — which is just as fatal: the ranks are now
+  desynced mid-protocol, so continuing to serve would make this leader
+  a zombie that accepts work it cannot answer while flapping in and
+  out of the router's health view (observed in the chaos drill before
+  this rule existed). Either way the process exits
+  :data:`PEER_TIMEOUT_RC`, the router sees the leader's probe fail,
+  evicts the LOGICAL replica, and hedges the in-flight requests
+  (drilled by ``tools/chaos_run.py --mode mesh``).
+
+Degenerate single-process mode (``jax.process_count() == 1``) keeps the
+exact engine semantics — every broadcast is the identity and the
+watchdog never starts — which is what the tier-1 pins in
+tests/test_serve.py exercise on the forced-8-device host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue as queue_lib
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from pytorch_cifar_tpu import faults
+from pytorch_cifar_tpu.obs import trace
+from pytorch_cifar_tpu.parallel.mesh import broadcast_pytree
+
+log = logging.getLogger(__name__)
+
+# command frame (int64[4]): [op, n_rows, sequence, reserved]. Fixed size
+# so a follower can always post the placeholder without knowing what is
+# coming — the op then tells it the shape of any payload broadcast.
+_CMD_LEN = 4
+OP_HEARTBEAT = 0
+OP_BATCH = 1
+OP_SWAP = 2
+OP_SHUTDOWN = 3
+
+# exit code of a process that detected a dead/wedged collective peer:
+# the launcher (router_run) and the chaos drill key on "non-zero within
+# timeout_s", and 70 (EX_SOFTWARE) never collides with a signal death
+PEER_TIMEOUT_RC = 70
+
+# graftcheck thread-collective sanction (STATIC_ANALYSIS.md): the ONE
+# background thread in the job allowed to start host collectives.
+GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES = {
+    "MeshReplica._dispatch_loop": (
+        "single-initiator lock-step protocol: this is the only thread "
+        "in the whole multi-process job that starts collectives, and "
+        "followers answer on their main thread in exactly the order it "
+        "broadcasts — the per-process-timing divergence the rule "
+        "guards against is structurally absent, and the watchdog "
+        "bounds a dead peer with a process exit instead of a hang"
+    ),
+}
+
+
+class MeshReplicaError(RuntimeError):
+    """Protocol-level failure of the multi-process replica."""
+
+
+class MeshReplicaClosed(MeshReplicaError):
+    """The replica is shut down and accepts no new work."""
+
+
+class _Watchdog:
+    """Bounded detection of a peer that will never arrive at a
+    collective. A stuck gloo transfer cannot be interrupted from Python
+    — no exception, no timeout knob on this jaxlib — so the only safe
+    recovery is to take the whole process down: ``exit_fn`` (default
+    ``os._exit``) fires once an armed deadline expires. Injectable for
+    tests; ``arm``/``disarm`` are cheap enough to wrap every collective."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        registry=None,
+        exit_fn=os._exit,
+        interval_s: float = 0.25,
+    ):
+        self.timeout_s = float(timeout_s)
+        self._exit_fn = exit_fn
+        self._interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._deadline: Optional[float] = None
+        self._why = ""
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._c_timeouts = (
+            registry.counter("serve.mesh.peer_timeouts")
+            if registry is not None
+            else None
+        )
+
+    def arm(self, why: str) -> None:
+        with self._lock:
+            self._deadline = time.monotonic() + self.timeout_s
+            self._why = why
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._deadline = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            with self._lock:
+                deadline, why = self._deadline, self._why
+            if deadline is not None and time.monotonic() > deadline:
+                log.error(
+                    "mesh replica watchdog: no collective progress for "
+                    "%.1fs (%s) — a peer process is dead or wedged; "
+                    "exiting rc=%d so the router can evict this logical "
+                    "replica instead of hanging on it",
+                    self.timeout_s, why, PEER_TIMEOUT_RC,
+                )
+                if self._c_timeouts is not None:
+                    self._c_timeouts.inc()
+                self._exit_fn(PEER_TIMEOUT_RC)
+                return  # injected exit_fn (tests) does not exit
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="mesh-watchdog", daemon=True
+                )
+                self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join()
+
+
+class MeshReplica:
+    """The engine-shaped coordinator of one multi-process mesh replica.
+
+    Presents the :class:`InferenceEngine` surface the micro-batcher,
+    hot-reload watcher, and HTTP backend already consume (``predict``,
+    ``swap_weights``, ``bucket_for``, ``buckets``, ``staging``, ...), so
+    the leader's serving stack is byte-for-byte the single-host stack
+    with this object in the engine's seat. See the module docstring for
+    the protocol."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        timeout_s: float = 60.0,
+        heartbeat_s: Optional[float] = None,
+        registry=None,
+        exit_fn=os._exit,
+    ):
+        import jax
+
+        self.engine = engine
+        self.process_index = int(jax.process_index())
+        self.process_count = int(jax.process_count())
+        self.is_leader = self.process_index == 0
+        self.timeout_s = float(timeout_s)
+        # idle leader keep-alive cadence: well under timeout_s so a
+        # healthy-but-quiet replica never trips a follower's watchdog
+        self.heartbeat_s = (
+            float(heartbeat_s)
+            if heartbeat_s is not None
+            else max(0.5, self.timeout_s / 4.0)
+        )
+        # a drain (MicroBatcher.close) behind a wedged collective is
+        # bounded by the watchdog killing the process; give close() a
+        # join bound past that so it can never outwait its own death
+        self.drain_timeout_s = 2.0 * self.timeout_s
+        self.barrier_generation = 0
+        self._seq = 0
+        self._queue: queue_lib.Queue = queue_lib.Queue()
+        self._lock = threading.Lock()  # closed flag + dispatch handle
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._obs = registry
+        reg = registry
+        self._c_dispatches = (
+            reg.counter("serve.mesh.dispatches") if reg else None
+        )
+        self._c_swaps = reg.counter("serve.mesh.swaps") if reg else None
+        self._c_heartbeats = (
+            reg.counter("serve.mesh.heartbeats") if reg else None
+        )
+        self._h_broadcast = (
+            reg.histogram("serve.mesh.broadcast_ms") if reg else None
+        )
+        if reg is not None:
+            reg.gauge("serve.mesh.processes").set(self.process_count)
+            reg.gauge("serve.mesh.local_devices").set(
+                jax.local_device_count()
+            )
+        self._exit_fn = exit_fn
+        self._watchdog = _Watchdog(
+            self.timeout_s, registry=registry, exit_fn=exit_fn
+        )
+        # follower swap placeholder: zeros at the engine's raw host avals
+        # (broadcast_pytree needs a structurally identical tree on every
+        # process; the values only matter on the leader)
+        host = engine.weights_host()
+        self._weight_placeholder = jax.tree_util.tree_map(
+            lambda a: np.zeros(np.shape(a), np.asarray(a).dtype), host
+        )
+        # bootstrap: every process serves the LEADER's weights — whatever
+        # each rank loaded from its own disk, the served state is
+        # bit-identical by construction (the same broadcast path every
+        # later hot reload takes)
+        if self.process_count > 1:
+            trees = broadcast_pytree(
+                host if self.is_leader else self._weight_placeholder
+            )
+            engine.swap_weights(trees[0], trees[1])
+        self.warmup_barrier()
+        if self.process_count > 1:
+            self._watchdog.start()
+        if self.is_leader:
+            with self._lock:
+                self._thread = threading.Thread(
+                    target=self._dispatch_loop,
+                    name="mesh-dispatch",
+                    daemon=True,
+                )
+                self._thread.start()
+        else:
+            # armed from here on: the leader heartbeats while idle, so a
+            # silent leader within timeout_s means it is gone
+            self._watchdog.arm("waiting for the first leader command")
+
+    # -- distributed warmup barrier ------------------------------------
+
+    def warmup_barrier(self) -> None:
+        """Collective rendezvous per bucket before the replica may serve
+        (the SERVING.md deferral): every process runs the canonical
+        probe batch through its compiled program — the execution itself
+        blocks until all peers arrive, so a straggler still compiling or
+        importing holds everyone at the barrier — and the leader's
+        logits are broadcast and checked bit-identical on every process.
+        Weights agree by the bootstrap broadcast; this checks that the
+        EXECUTABLES agree (a process that imported a divergent cache
+        entry or compiled against different avals fails loudly here,
+        before the replica reports healthy). Advances
+        ``barrier_generation`` (surfaced via /healthz) on success."""
+        eng = self.engine
+        if not eng._compiled:
+            eng.warmup()
+        probe_weights = eng._probe_weights()
+        for b in eng.buckets:
+            got = eng._run_probe(
+                eng._compiled[b], probe_weights, eng._probe_batch(b)
+            )
+            if self.process_count > 1:
+                ref = broadcast_pytree(
+                    got if self.is_leader else np.zeros_like(got)
+                )
+                if not np.array_equal(ref, got):
+                    raise MeshReplicaError(
+                        f"process {self.process_index} diverges from the "
+                        f"leader at bucket {b} during the warmup barrier "
+                        f"(max |diff| {np.max(np.abs(ref - got))}): this "
+                        f"process must not serve"
+                    )
+        self.barrier_generation += 1
+        if self._obs is not None:
+            self._obs.gauge("serve.mesh.barrier_generation").set(
+                self.barrier_generation
+            )
+        trace.instant(
+            "serve/mesh_barrier",
+            generation=self.barrier_generation,
+            processes=self.process_count,
+        )
+
+    # -- engine-shaped surface (leader) --------------------------------
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """uint8 NHWC batch of any size -> fp32 logits, computed by the
+        WHOLE multi-process mesh. Leader only — followers mirror through
+        :meth:`follower_loop`."""
+        if not self.is_leader:
+            raise MeshReplicaError(
+                "predict() is leader-only; followers run follower_loop()"
+            )
+        # chaos injection point, BEFORE anything is broadcast: an
+        # injected engine failure fails only this batch and never
+        # desyncs the follower protocol (the batcher contains it)
+        faults.maybe_raise("serve_error")
+        x = np.asarray(images)
+        if x.ndim != 4 or x.shape[1:] != self.engine.image_shape:
+            raise ValueError(
+                f"expected (n, "
+                f"{', '.join(map(str, self.engine.image_shape))}) images, "
+                f"got {x.shape}"
+            )
+        return self._submit(OP_BATCH, x).result()
+
+    def swap_weights(self, params, batch_stats) -> int:
+        """Atomic fleet-wide weight swap: validates avals on THIS thread
+        (a wrong-model checkpoint is rejected before any broadcast),
+        then the dispatch loop broadcasts the trees and every process
+        swaps the same generation in lock-step."""
+        self.engine.check_swap_avals(params, batch_stats)
+        return self._submit(OP_SWAP, (params, batch_stats or {})).result()
+
+    def weights_host(self):
+        return self.engine.weights_host()
+
+    def bucket_for(self, n: int) -> int:
+        return self.engine.bucket_for(n)
+
+    def shard_split(self, n: int):
+        return self.engine.shard_split(n)
+
+    def mesh_health(self) -> dict:
+        """The topology block /healthz surfaces so a half-joined replica
+        is diagnosable from a probe (ISSUE: process span, per-process
+        devices, barrier generation)."""
+        import jax
+
+        return {
+            "process_count": self.process_count,
+            "process_index": self.process_index,
+            "local_devices": int(jax.local_device_count()),
+            "global_devices": int(self.engine.n_devices),
+            "barrier_generation": int(self.barrier_generation),
+            "timeout_s": self.timeout_s,
+            "engine_version": int(self.engine.version),
+        }
+
+    # the rest of the engine surface the batcher / backend / watcher /
+    # CLI read — plain delegation, so the leader's serving stack needs
+    # no mesh-awareness anywhere else
+    @property
+    def buckets(self):
+        return self.engine.buckets
+
+    @property
+    def n_devices(self) -> int:
+        return self.engine.n_devices
+
+    @property
+    def staging(self):
+        return self.engine.staging
+
+    @property
+    def model_name(self) -> str:
+        return self.engine.model_name
+
+    @property
+    def num_classes(self) -> int:
+        return self.engine.num_classes
+
+    @property
+    def image_shape(self):
+        return self.engine.image_shape
+
+    @property
+    def compile_count(self) -> int:
+        return self.engine.compile_count
+
+    @property
+    def version(self) -> int:
+        return self.engine.version
+
+    @property
+    def aot_cache_hits(self) -> int:
+        return self.engine.aot_cache_hits
+
+    @property
+    def aot_cache_misses(self) -> int:
+        return self.engine.aot_cache_misses
+
+    @property
+    def cold_start_s(self) -> float:
+        return self.engine.cold_start_s
+
+    @property
+    def checkpoint_meta(self) -> dict:
+        return getattr(self.engine, "checkpoint_meta", {})
+
+    # -- leader dispatch -----------------------------------------------
+
+    def _fatal(self, why: str) -> None:
+        """A collective RAISED with peers attached (module docstring,
+        failure mode b): the ranks are desynced mid-protocol, so this
+        process must leave the fleet rather than zombie-serve. Same exit
+        code as the watchdog's hang detection — the launcher and router
+        see one failure class either way."""
+        log.error(
+            "mesh replica: collective failed (%s) — the ranks are "
+            "desynced; exiting rc=%d so the router evicts this logical "
+            "replica instead of flapping on a zombie", why,
+            PEER_TIMEOUT_RC,
+        )
+        if self._obs is not None:
+            self._obs.counter("serve.mesh.peer_timeouts").inc()
+        self._exit_fn(PEER_TIMEOUT_RC)
+
+    def _submit(self, op: int, payload) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise MeshReplicaClosed("mesh replica is shut down")
+            self._queue.put((op, payload, fut))
+        return fut
+
+    def _cmd(self, op: int, n: int) -> np.ndarray:
+        with self._lock:  # _seq is read by mesh_health/tests off-thread
+            self._seq += 1
+            seq = self._seq
+        return np.asarray([op, n, seq, 0], np.int64)
+
+    def _dispatch_loop(self) -> None:
+        """The single collective initiator (module docstring; declared
+        in GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES). Drains the work
+        queue in FIFO order, broadcasting each item to the followers and
+        entering the shared bucket program with them; broadcasts a
+        heartbeat when idle so follower watchdogs can tell a quiet
+        leader from a dead one. Every collective is bracketed by the
+        watchdog — a peer that never arrives turns into a bounded
+        process exit, not a hang."""
+        multi = self.process_count > 1
+        while True:
+            try:
+                op, payload, fut = self._queue.get(
+                    timeout=self.heartbeat_s
+                )
+            except queue_lib.Empty:
+                if multi:
+                    try:
+                        self._watchdog.arm("heartbeat broadcast")
+                        broadcast_pytree(self._cmd(OP_HEARTBEAT, 0))
+                        self._watchdog.disarm()
+                    except Exception as e:
+                        self._watchdog.disarm()
+                        self._fatal(f"heartbeat broadcast: {e}")
+                        return  # injected exit_fn (tests) does not exit
+                    if self._c_heartbeats is not None:
+                        self._c_heartbeats.inc()
+                continue
+            if op == OP_SHUTDOWN:
+                try:
+                    if multi:
+                        self._watchdog.arm("shutdown broadcast")
+                        broadcast_pytree(self._cmd(OP_SHUTDOWN, 0))
+                        self._watchdog.disarm()
+                except Exception as e:  # peers already gone: still done
+                    self._watchdog.disarm()
+                    log.warning("shutdown broadcast failed: %s", e)
+                fut.set_result(None)
+                return
+            if op == OP_SWAP:
+                try:
+                    self._watchdog.arm("weight-swap broadcast")
+                    if multi:
+                        broadcast_pytree(self._cmd(OP_SWAP, 0))
+                        payload = broadcast_pytree(payload)
+                    version = self.engine.swap_weights(
+                        payload[0], payload[1]
+                    )
+                    self._watchdog.disarm()
+                    if self._c_swaps is not None:
+                        self._c_swaps.inc()
+                    fut.set_result(version)
+                except Exception as e:
+                    self._watchdog.disarm()
+                    fut.set_exception(e)
+                    if multi:
+                        # followers may already have swapped: desynced
+                        self._fatal(f"swap broadcast: {e}")
+                        return
+                continue
+            # OP_BATCH: chunk through the largest bucket — one command +
+            # payload broadcast + collective bucket call per chunk, the
+            # same chunking engine.predict applies
+            try:
+                x = payload
+                cap = self.engine.buckets[-1]
+                outs = []
+                for off in range(0, x.shape[0], cap):
+                    chunk = np.ascontiguousarray(x[off : off + cap])
+                    self._watchdog.arm(
+                        f"batch broadcast+execute (n={chunk.shape[0]})"
+                    )
+                    t0 = time.perf_counter()
+                    if multi:
+                        broadcast_pytree(
+                            self._cmd(OP_BATCH, chunk.shape[0])
+                        )
+                        chunk = broadcast_pytree(chunk)
+                        if self._h_broadcast is not None:
+                            self._h_broadcast.observe(
+                                (time.perf_counter() - t0) * 1e3
+                            )
+                    outs.append(self.engine._run_bucket(chunk))
+                    self._watchdog.disarm()
+                if self._c_dispatches is not None:
+                    self._c_dispatches.inc()
+                fut.set_result(
+                    outs[0] if len(outs) == 1 else np.concatenate(outs)
+                )
+            except Exception as e:
+                self._watchdog.disarm()
+                fut.set_exception(e)
+                if multi:
+                    # a command or payload broadcast (or the collective
+                    # bucket call) failed with peers attached: fatal —
+                    # a local engine error cannot reach here multi-
+                    # process, the broadcast is the first thing a chunk
+                    # does (and predict() runs its fault injection
+                    # BEFORE submitting)
+                    self._fatal(f"batch dispatch: {e}")
+                    return
+
+    # -- follower ------------------------------------------------------
+
+    def follower_loop(self) -> None:
+        """Run on a follower's MAIN thread until the leader broadcasts
+        shutdown: block on the next command, mirror it (enter the bucket
+        program / swap the broadcast weights / ignore a heartbeat). The
+        watchdog is re-armed on every received command, so a leader that
+        dies takes this process down within ``timeout_s`` instead of
+        leaving it wedged in gloo forever."""
+        if self.is_leader:
+            raise MeshReplicaError("follower_loop() is follower-only")
+        eng = self.engine
+        try:
+            self._follower_loop_body(eng)
+        except Exception as e:  # failure mode (b): desynced, terminal
+            self._watchdog.disarm()
+            self._fatal(f"follower collective: {e}")
+        finally:
+            self._watchdog.disarm()
+            self._watchdog.stop()
+            with self._lock:
+                self._closed = True
+
+    def _follower_loop_body(self, eng) -> None:
+        while True:
+            self._watchdog.arm("waiting for the next leader command")
+            cmd = broadcast_pytree(np.zeros(_CMD_LEN, np.int64))
+            op, n = int(cmd[0]), int(cmd[1])
+            if op == OP_HEARTBEAT:
+                if self._c_heartbeats is not None:
+                    self._c_heartbeats.inc()
+                continue
+            if op == OP_SHUTDOWN:
+                return
+            if op == OP_SWAP:
+                trees = broadcast_pytree(self._weight_placeholder)
+                eng.swap_weights(trees[0], trees[1])
+                if self._c_swaps is not None:
+                    self._c_swaps.inc()
+                continue
+            if op == OP_BATCH:
+                x = broadcast_pytree(
+                    np.zeros((n, *eng.image_shape), np.uint8)
+                )
+                eng._run_bucket(x)
+                if self._c_dispatches is not None:
+                    self._c_dispatches.inc()
+                continue
+            raise MeshReplicaError(
+                f"unknown mesh command op={op} (protocol skew?)"
+            )
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self) -> None:
+        """Leader: drain the dispatch queue's tail, broadcast shutdown
+        (followers' loops return), join the dispatch thread and stop the
+        watchdog. Idempotent; follower close is a local flag (its loop
+        exits on the leader's broadcast)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if not self.is_leader:
+            return
+        fut: Future = Future()
+        self._queue.put((OP_SHUTDOWN, None, fut))
+        try:
+            fut.result(timeout=self.drain_timeout_s)
+        except Exception:  # watchdog will have killed a wedged process
+            log.warning("mesh replica shutdown broadcast did not confirm")
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join(timeout=self.drain_timeout_s)
+        self._watchdog.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

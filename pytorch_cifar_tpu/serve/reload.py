@@ -17,7 +17,14 @@ engine is a REPLICATED ``device_put`` over every chip — so a hot reload
 lands on the whole mesh in the same atomic assignment, and the
 compile-count guarantee (no recompiles on swap) is identical to the
 single-device path (pinned by tests/test_serve.py on the forced-8-device
-CPU host).
+CPU host). A MULTI-PROCESS mesh replica (SERVING.md "Multi-process mesh
+replica") is the same contract one level up: the watcher runs on the
+LEADER only, its ``engine`` seat holds the
+:class:`~pytorch_cifar_tpu.serve.mesh_replica.MeshReplica`, and that
+``swap_weights`` validates avals on this thread, then broadcasts the
+trees so every process swaps the SAME generation atomically — followers
+never watch the filesystem, so the ranks cannot race each other onto
+different publishes.
 
 **A half-written checkpoint is never served** (ROBUSTNESS.md): the loader
 verifies the sidecar's CRC32/size manifest against the payload before the
@@ -82,6 +89,12 @@ class CheckpointWatcher:
         # (logged once; the flag doubles as the once-latch)
         self._staging_refused = False
         self.last_meta: dict = {}
+        # engine version (weight generation) returned by the newest
+        # successful swap — on a mesh replica this generation landed on
+        # EVERY process of the mesh (the broadcast swap contract), so
+        # surfacing it here lets /healthz and tests pin fleet-wide
+        # generation agreement without reaching into the engine
+        self.last_version: Optional[int] = None
         # obs registry (optional): the counters mirror the attributes
         # above so the serving exporter/Prometheus dump carries reload
         # health without callers polling watcher attributes
@@ -226,6 +239,7 @@ class CheckpointWatcher:
         with self._lock:
             self._last_sig = sig
             self.last_meta = meta
+            self.last_version = version
             self.reloads += 1
         count("reloads")
         trace.instant(

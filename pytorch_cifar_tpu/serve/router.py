@@ -316,9 +316,22 @@ class Router:
         if evict:
             self._c_evictions.inc()
             self._g_healthy.set(healthy)
+            # a multi-process mesh replica (SERVING.md) dies as ONE
+            # logical unit — one dead rank takes the leader down within
+            # its watchdog bound — so name the topology in the eviction:
+            # "2-process replica gone" reads very differently from a
+            # single-host crash when an operator pages in
+            mesh = (replica.last_health or {}).get("mesh") or {}
             log.warning(
-                "evicted replica %s after %d consecutive failures (%s)",
+                "evicted replica %s after %d consecutive failures (%s)%s",
                 replica.url, replica.consecutive_failures, why,
+                (
+                    f" [mesh replica: {mesh.get('process_count')} "
+                    f"processes x {mesh.get('local_devices')} devices, "
+                    f"barrier generation {mesh.get('barrier_generation')}]"
+                    if mesh
+                    else ""
+                ),
             )
 
     def _mark_success(self, replica: Replica, health=None) -> None:

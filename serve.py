@@ -246,13 +246,77 @@ def _main_zoo(cfg, registry, platform, compute_dtype) -> int:
     return 0
 
 
+def _init_mesh_processes(cfg) -> None:
+    """Rendezvous the mesh-replica ranks (SERVING.md "Multi-process mesh
+    replica") BEFORE any backend-initializing jax call — after the first
+    device touch, ``jax.distributed.initialize`` is permanently too late
+    and the rank would silently serve its local devices alone."""
+    import os
+
+    if not cfg.mesh_coord:
+        raise SystemExit(
+            "--mesh_procs > 1 needs --mesh_coord host:port (the "
+            "coordinator address every rank shares)"
+        )
+    if cfg.models:
+        raise SystemExit(
+            "mesh-sharded zoo tenants are deferred (SERVING.md): "
+            "--mesh_procs and --models are mutually exclusive"
+        )
+    import jax
+
+    from pytorch_cifar_tpu.parallel.mesh import initialize_distributed
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # without an explicit cross-process collectives implementation
+        # the CPU client silently comes up single-process
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    initialize_distributed(cfg.mesh_coord, cfg.mesh_procs, cfg.mesh_rank)
+
+
+def _mesh_follower(cfg, replica, engine) -> int:
+    """Run one follower rank of the mesh replica: answer the leader's
+    command broadcasts on this (main) thread until it says shutdown,
+    then print the follower's JSON record. SIGTERM is a no-op here — the
+    leader's shutdown broadcast is the real drain signal (the launcher
+    TERMs the leader FIRST; the watchdog bounds the wait if the leader
+    is already gone)."""
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *a: None)
+    print(
+        f"==> mesh: follower {replica.process_index}/"
+        f"{replica.process_count} ready "
+        f"(barrier generation {replica.barrier_generation})",
+        file=sys.stderr,
+    )
+    replica.follower_loop()
+    print(
+        json.dumps(
+            {
+                "role": "mesh_follower",
+                "process_index": replica.process_index,
+                "process_count": replica.process_count,
+                "engine_version": engine.version,
+                "compiles": engine.compile_count,
+                "aot_cache_hits": engine.aot_cache_hits,
+                "aot_cache_misses": engine.aot_cache_misses,
+                "barrier_generation": replica.barrier_generation,
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     from pytorch_cifar_tpu import enable_compilation_cache, honor_platform_env
     from pytorch_cifar_tpu.config import parse_serve_config
 
     honor_platform_env()
-    enable_compilation_cache()
     cfg = parse_serve_config()
+    if cfg.mesh_procs > 1:
+        _init_mesh_processes(cfg)
+    enable_compilation_cache()
 
     import jax
     import jax.numpy as jnp
@@ -272,7 +336,9 @@ def main() -> int:
     from pytorch_cifar_tpu.serve.loadgen import run_load
     from pytorch_cifar_tpu.utils import set_logger
 
-    set_logger(None)  # single-process serving: rank-0 console verbosity
+    # rank-aware console: one process serving = full verbosity; mesh
+    # follower ranks log WARNING+ only (the leader narrates the replica)
+    set_logger(None, process_index=jax.process_index())
     # ONE registry through engine + batcher + watcher: the exporter and
     # the Prometheus dump see the whole serving process (OBSERVABILITY.md)
     registry = MetricsRegistry()
@@ -290,8 +356,9 @@ def main() -> int:
         return _main_zoo(cfg, registry, platform, compute_dtype)
 
     # data-parallel serving mesh, mirroring train's --num_devices (0 =
-    # all local devices). A 1-device request keeps the exact single-chip
-    # engine path (no sharded puts, no bucket rounding).
+    # all local devices; under --mesh_procs the mesh spans every rank's
+    # devices). A 1-device request keeps the exact single-chip engine
+    # path (no sharded puts, no bucket rounding).
     mesh = make_mesh(cfg.num_devices)
     n_devices = int(mesh.devices.size)
     if n_devices == 1:
@@ -300,7 +367,13 @@ def main() -> int:
     print(
         f"==> loading {cfg.model} from {cfg.ckpt} "
         f"(buckets {tuple(cfg.buckets)}, {cfg.dtype}, {platform} "
-        f"x{n_devices})",
+        f"x{n_devices}"
+        + (
+            f" over {cfg.mesh_procs} processes, rank {cfg.mesh_rank}"
+            if cfg.mesh_procs > 1
+            else ""
+        )
+        + ")",
         file=sys.stderr,
     )
     engine = InferenceEngine.from_checkpoint(
@@ -329,6 +402,29 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # multi-process mesh replica (SERVING.md): wrap the engine in the
+    # coordinator — bootstrap weight broadcast + distributed warmup
+    # barrier run inside, collectively on every rank — then followers
+    # peel off into their lock-step loop while the leader serves with
+    # the replica in the engine seat (batcher/watcher/frontend are
+    # untouched: they see the same engine surface).
+    replica = None
+    if cfg.mesh_procs > 1:
+        from pytorch_cifar_tpu.serve import MeshReplica
+
+        replica = MeshReplica(
+            engine, timeout_s=cfg.mesh_timeout_s, registry=registry
+        )
+        print(
+            f"==> mesh: replica spans {replica.process_count} processes "
+            f"x {n_devices // replica.process_count} devices, barrier "
+            f"generation {replica.barrier_generation}",
+            file=sys.stderr,
+        )
+        if not replica.is_leader:
+            return _mesh_follower(cfg, replica, engine)
+    serving_engine = replica if replica is not None else engine
+
     if cfg.verify:
         rs = np.random.RandomState(cfg.seed)
         # an off-bucket size, so the padded path is actually exercised
@@ -340,7 +436,7 @@ def main() -> int:
             else (bks[1] - 1 if len(bks) > 1 else 1)
         )
         x = rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
-        padded, direct = engine.predict(x), engine.direct_forward(x)
+        padded, direct = serving_engine.predict(x), engine.direct_forward(x)
         if not np.array_equal(padded, direct):
             print(
                 "error: padded bucket forward is not bit-identical to the "
@@ -355,7 +451,7 @@ def main() -> int:
         )
 
     batcher = MicroBatcher(
-        engine,
+        serving_engine,
         max_batch=cfg.max_batch or None,
         max_wait_ms=cfg.max_wait_ms,
         max_queue=cfg.max_queue,
@@ -376,8 +472,10 @@ def main() -> int:
         ).start()
     watcher = None
     if cfg.watch:
+        # on a mesh replica the watcher's swap routes through the
+        # leader's broadcast, so every rank swaps the same generation
         watcher = CheckpointWatcher(
-            engine, cfg.ckpt, poll_s=cfg.poll_s, registry=registry
+            serving_engine, cfg.ckpt, poll_s=cfg.poll_s, registry=registry
         ).start()
         print(
             f"==> watching {cfg.ckpt} for new best checkpoints "
@@ -390,7 +488,8 @@ def main() -> int:
             from pytorch_cifar_tpu.serve import BatcherBackend
 
             report = _serve_http(
-                cfg, BatcherBackend(engine, batcher, watcher=watcher),
+                cfg,
+                BatcherBackend(serving_engine, batcher, watcher=watcher),
                 registry,
             )
         else:
@@ -407,6 +506,8 @@ def main() -> int:
         if watcher is not None:
             watcher.stop()
         batcher.close()  # graceful drain
+        if replica is not None:
+            replica.close()  # broadcast shutdown: followers drain too
         if exporter is not None:
             exporter.stop()
         if cfg.prom_out:
@@ -428,6 +529,10 @@ def main() -> int:
         # per-chip throughput, so serve numbers land next to the train
         # metric (images/sec/chip) in the MULTICHIP series
         "n_devices": n_devices,
+        # cross-host serving (SERVING.md "Multi-process mesh replica"):
+        # process span + barrier generation of the logical replica
+        "mesh_procs": cfg.mesh_procs,
+        "mesh": replica.mesh_health() if replica is not None else None,
         "buckets": list(engine.buckets),
         "max_batch": batcher.max_batch,
         "max_wait_ms": cfg.max_wait_ms,

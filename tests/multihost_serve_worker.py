@@ -1,0 +1,204 @@
+"""Worker for the multi-process mesh-replica serving tests.
+
+One process (rank) of an N-process logical serving replica
+(serve/mesh_replica.py; SERVING.md "Multi-process mesh replica"), or the
+single-process comparator the bit-identity pins diff against. Driven by
+tests/test_multihost.py over the same localhost-gloo rendezvous as the
+training workers.
+
+Usage: multihost_serve_worker.py <pid> <nproc> <port> <out_dir> [mode]
+
+Modes:
+- "serve" (default): leader builds an engine over the global mesh,
+  wraps it in a MeshReplica, and answers fixed probe batches three ways
+  — in-process predict, HTTP/JSON, HTTP/binary-wire — printing the raw
+  logits (float32 survives JSON exactly via float64 repr) so the driver
+  can diff them bit-for-bit against the single-process comparator.
+  Rank 1 sleeps before building its engine: the leader MUST wait at the
+  warmup barrier for the straggler (no process serves ahead of a peer).
+  nproc=1: the comparator — the plain single-host replica stack
+  (engine + micro-batcher + frontend, no MeshReplica) on the same
+  global device count.
+- "swap": after serving one batch, the leader hot-swaps a second
+  deterministic weight set through the broadcast path; every process
+  prints its engine version and a post-swap weight checksum — the
+  driver asserts the swap landed the same generation and the same bytes
+  on every rank.
+- "warm": engine built with an AOT cache under <out_dir>/aot. First
+  invocation compiles + exports per-process topology-keyed entries;
+  the second imports them — the driver asserts compiles == 0 and
+  aot_cache_hits == len(buckets) on EVERY process with logits unchanged.
+
+Prints one JSON line per process.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BUCKETS = (1, 4, 8)
+SIZES = (1, 3, 8, 20)  # singleton, padded, exact, chunked-past-the-cap
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    out_dir = sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "serve"
+
+    from pytorch_cifar_tpu import honor_platform_env
+    from pytorch_cifar_tpu.parallel.mesh import initialize_distributed
+
+    honor_platform_env()
+    if nproc > 1:
+        import jax
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        initialize_distributed(f"localhost:{port}", nproc, pid)
+
+    import jax
+    import numpy as np
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import (
+        BatcherBackend,
+        InferenceEngine,
+        MeshReplica,
+        MicroBatcher,
+        ServingFrontend,
+    )
+
+    assert jax.process_count() == nproc
+    if pid == 1 and mode == "serve":
+        # straggler: the leader's warmup barrier must WAIT for this rank
+        # (a leader that served before every peer compiled would answer
+        # from a half-joined replica)
+        time.sleep(2.0)
+
+    registry = MetricsRegistry()
+    cache = str(Path(out_dir) / "aot") if mode == "warm" else None
+    engine = InferenceEngine.from_random(
+        "LeNet", seed=0, buckets=BUCKETS, registry=registry,
+        mesh=make_mesh(), aot_cache_dir=cache,
+    )
+    rec = {
+        "pid": pid,
+        "compiles": int(engine.compile_count),
+        "aot_hits": int(engine.aot_cache_hits),
+        "buckets": [int(b) for b in engine.buckets],
+    }
+
+    def psum(trees) -> float:
+        return float(
+            sum(
+                np.abs(np.asarray(leaf, np.float64)).sum()
+                for leaf in jax.tree_util.tree_leaves(trees)
+            )
+        )
+
+    def probe(n: int) -> np.ndarray:
+        rs = np.random.RandomState(100 + n)
+        return rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+
+    if nproc == 1:
+        # the single-host comparator: the production single-process
+        # replica stack, same buckets, same global device count
+        batcher = MicroBatcher(engine, max_wait_ms=1.0, registry=registry)
+        frontend = ServingFrontend(
+            BatcherBackend(engine, batcher), registry=registry
+        ).start()
+        rec.update(_serve_and_record(engine, batcher, frontend, probe))
+        if mode == "swap":
+            rec.update(_swap_and_record(engine, engine, psum, probe))
+        frontend.stop()
+        batcher.close()
+        print(json.dumps(rec), flush=True)
+        return 0
+
+    replica = MeshReplica(engine, timeout_s=30.0, registry=registry)
+    rec["barrier_generation"] = replica.barrier_generation
+    if not replica.is_leader:
+        replica.follower_loop()
+        rec["engine_version"] = int(engine.version)
+        rec["weights_psum"] = psum(engine.weights_host())
+        print(json.dumps(rec), flush=True)
+        return 0
+
+    batcher = MicroBatcher(replica, max_wait_ms=1.0, registry=registry)
+    frontend = ServingFrontend(
+        BatcherBackend(replica, batcher), registry=registry
+    ).start()
+    rec.update(_serve_and_record(replica, batcher, frontend, probe))
+    rec["mesh_health"] = replica.mesh_health()
+    if mode == "swap":
+        rec.update(_swap_and_record(replica, engine, psum, probe))
+    frontend.stop()
+    batcher.close()
+    replica.close()
+    rec["engine_version"] = int(engine.version)
+    rec["weights_psum"] = psum(engine.weights_host())
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+def _serve_and_record(target, batcher, frontend, probe) -> dict:
+    """Answer every probe size in-process AND over both wire encodings;
+    record the raw logits (bit-transparent through JSON) plus equality
+    of the wire paths against the in-process answer."""
+    import numpy as np
+
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget
+
+    logits = {}
+    wire_json_equal = wire_binary_equal = True
+    json_target = HttpTarget(frontend.url, wire="json")
+    bin_target = HttpTarget(frontend.url, wire="binary")
+    try:
+        for n in SIZES:
+            x = probe(n)
+            inproc = batcher.predict(x)
+            direct = target.predict(x)
+            via_json = json_target.submit(x).result()
+            via_bin = bin_target.submit(x).result()
+            wire_json_equal &= bool(np.array_equal(inproc, via_json))
+            wire_binary_equal &= bool(np.array_equal(inproc, via_bin))
+            wire_json_equal &= bool(np.array_equal(inproc, direct))
+            logits[str(n)] = [float(v) for v in np.asarray(inproc).ravel()]
+    finally:
+        json_target.close()
+        bin_target.close()
+    return {
+        "logits": logits,
+        "wire_json_equal": wire_json_equal,
+        "wire_binary_equal": wire_binary_equal,
+    }
+
+
+def _swap_and_record(target, engine, psum, probe) -> dict:
+    """Hot-swap a second deterministic weight set through the target's
+    swap path (the broadcast path on a mesh replica) and record the
+    post-swap logits + version."""
+    import numpy as np
+
+    from pytorch_cifar_tpu.serve import InferenceEngine
+
+    donor = InferenceEngine.from_random(
+        "LeNet", seed=1, buckets=BUCKETS, warmup=False,
+    )
+    params, stats = donor.weights_host()
+    version = target.swap_weights(params, stats)
+    x = probe(3)
+    return {
+        "swap_version": int(version),
+        "swap_logits": [float(v) for v in np.asarray(
+            target.predict(x)
+        ).ravel()],
+        "donor_psum": psum((params, stats)),
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -257,6 +257,40 @@ def test_bench_serve_zoo_mode_prints_one_json_line():
     assert rec["obs"]["unknown_model"] == 0.0
 
 
+def test_bench_serve_mesh_mode_prints_one_json_line():
+    """--serve-mesh (the cross-host serving PR): the driver contract for
+    the 2-process mesh replica A/B — warm mesh img/s as `value`, the
+    mesh-vs-single-host ratio at equal global devices, and THE warm-start
+    acceptance pin: the second mesh launch imports every bucket program
+    from the topology-aware AOT cache with zero compiles on EVERY rank.
+    Slow-marked (conftest): it spawns five serve/train subprocesses with
+    a real 2-process gloo rendezvous."""
+    rec, _ = run_bench(
+        ["--serve-mesh", "--model", "LeNet", "--steps", "2"],
+        timeout=900,
+    )
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"] == "serve_mesh_2proc_LeNet_bfloat16_cpu", rec
+    assert rec["unit"] == "images/sec"
+    assert rec["value"] > 0
+    assert rec["mesh_procs"] == 2
+    # 2 ranks x 1 forced device each = a 2-device global mesh, matching
+    # the single-host comparator's device count
+    assert rec["n_devices"] == 2 and rec["single_n_devices"] == 2
+    assert rec["mesh"]["process_count"] == 2
+    assert rec["mesh"]["barrier_generation"] == 1
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    assert rec["p99_ms"] >= rec["p50_ms"] > 0
+    # the A/B (a ratio is a measurement, not a schema guarantee on a
+    # 1-core box — presence and positivity are)
+    assert rec["single_img_per_sec"] > 0
+    assert rec["mesh_vs_single"] > 0
+    # THE warm-start pin, per process [leader, follower]
+    assert rec["cold_compiles"] == [3, 3]  # buckets (2, 4, 8) cold
+    assert rec["warm_compiles"] == [0, 0]
+    assert rec["warm_aot_hits"] == [3, 3]
+
+
 def test_parse_child_record_skips_non_record_json_lines():
     """headline()'s child-stdout parsing (ADVICE round 5): stray brace-
     prefixed lines — dependency JSON warnings, malformed braces — must

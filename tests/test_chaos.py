@@ -170,6 +170,34 @@ def test_router_drill_sigkill_replica_under_load(tmp_path):
     assert rec["router_rc"] == 0
 
 
+def test_mesh_drill_follower_sigkill_bounded_detection(tmp_path):
+    """--mode mesh (SERVING.md "Multi-process mesh replica"): a fleet of
+    two 2-process logical replicas serves mixed-wire HTTP load; one
+    FOLLOWER rank of replica 0 is SIGKILLed. The leader must detect the
+    dead collective peer within the watchdog bound and exit rc 70
+    (PEER_TIMEOUT_RC — never a hang), the router must evict the logical
+    replica and transparently hedge, with ZERO client-visible errors in
+    every phase; /predict is bit-identical across both mesh replicas, a
+    single-host reference replica, and the router over both wire
+    encodings; replica 1 joined warm (compile_count == 0) from the
+    topology-aware AOT cache and survives as the whole fleet."""
+    rec = run_chaos("mesh", tmp_path, extra=("--epochs", "2"))
+    assert rec["match"] is True
+    assert rec["bit_identical"] is True
+    assert rec["warm_replica_compiles"] == 0
+    assert rec["mesh_health"]["process_count"] == 2
+    assert rec["mesh_health"]["barrier_generation"] == 1
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    # bounded dead-peer detection: SIGKILL -> leader exit, well inside
+    # the watchdog bound plus probe/poll slack
+    assert 0 < rec["detection_s"] <= rec["mesh_timeout_s"] + 10.0
+    assert rec["leader_rc"] == 70  # PEER_TIMEOUT_RC, not a hang/crash
+    assert rec["follower_rcs"][0][0] == -9  # the SIGKILLed rank
+    assert rec["follower_rcs"][1][0] == 0  # replica 1 drained cleanly
+    assert rec["evictions"] >= 1 and rec["healthy_after"] == 1
+    assert rec["router_rc"] == 0
+
+
 def test_zoo_drill_skewed_load_churn_and_replica_kill(tmp_path):
     """--mode zoo (SERVING.md "Multi-tenant zoo serving"): a 2-replica
     3-model zoo fleet (max_resident=2 — the tail tenant structurally

@@ -688,6 +688,132 @@ def test_thread_collective_negative(tmp_path):
     assert run_rule(tmp_path, src, "thread-collective") == []
 
 
+def test_thread_collective_sanctioned_entry_negative(tmp_path):
+    """The sanctioned follower-loop entry mechanism (STATIC_ANALYSIS.md
+    "thread-collective"): a declared single-initiator protocol loop may
+    run collectives — directly AND via helpers reachable only through
+    it — without a noqa. The mesh replica's dispatch-loop shape."""
+    src = """
+    import threading
+    from pytorch_cifar_tpu.parallel.mesh import broadcast_pytree
+
+    GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES = {
+        "Dispatcher._loop": (
+            "single-initiator lock-step protocol: the only thread that "
+            "starts collectives; followers respond on their main thread"
+        ),
+    }
+
+    class Dispatcher:
+        def _loop(self):
+            while True:
+                broadcast_pytree(self.cmd)
+                self._payload()
+
+        def _payload(self):
+            # reachable ONLY through the sanctioned entry: also exempt
+            broadcast_pytree(self.batch)
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+
+        def stop(self):
+            self._thread.join()
+    """
+    assert run_rule(tmp_path, src, "thread-collective") == []
+
+
+def test_thread_collective_sanction_does_not_cover_other_threads(tmp_path):
+    """Anything reachable from an UNDECLARED Thread target still fires —
+    including a helper the sanctioned entry shares with it, and a second
+    thread in the same module."""
+    src = """
+    import threading
+    from pytorch_cifar_tpu.parallel.mesh import broadcast_pytree
+
+    GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES = {
+        "Dispatcher._loop": "single-initiator protocol loop",
+    }
+
+    class Dispatcher:
+        def _loop(self):
+            while True:
+                self._shared_sync()
+
+        def _shared_sync(self):
+            # shared with the ROGUE thread below: the sanction removes
+            # _loop's taint, not this helper's other path
+            broadcast_pytree(self.cmd)
+
+        def _rogue(self):
+            self._shared_sync()
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._rogue_thread = threading.Thread(target=self._rogue)
+            self._thread.start()
+            self._rogue_thread.start()
+
+        def stop(self):
+            self._thread.join()
+            self._rogue_thread.join()
+    """
+    found = run_rule(tmp_path, src, "thread-collective")
+    assert len(found) == 1
+    assert "broadcast_pytree" in found[0].message
+    assert "_rogue" in found[0].message  # tainted via the rogue entry
+
+
+def test_thread_collective_sanction_declaration_discipline(tmp_path):
+    """A stale declaration (naming a def the module does not define) and
+    a reasonless one are themselves findings — the same mandatory-reason
+    policy as noqa, so a rename can never silently widen the sanction."""
+    src = """
+    import threading
+
+    GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES = {
+        "Dispatcher._renamed_away": "was the dispatch loop once",
+        "Dispatcher._loop": "",
+    }
+
+    class Dispatcher:
+        def _loop(self):
+            pass
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop)
+            self._thread.start()
+
+        def stop(self):
+            self._thread.join()
+    """
+    found = run_rule(tmp_path, src, "thread-collective")
+    assert len(found) == 2
+    stale = [f for f in found if "_renamed_away" in f.message]
+    assert len(stale) == 1 and "stale" in stale[0].message
+    reasonless = [f for f in found if "no reason" in f.message]
+    assert len(reasonless) == 1
+
+
+def test_mesh_replica_dispatch_loop_is_sanctioned_not_noqad():
+    """The real mesh replica: its dispatch loop broadcasts from a Thread
+    target and must pass via the DECLARED sanction (the module declares
+    GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES with a reason), with zero
+    thread-collective noqa comments anywhere in the module."""
+    path = os.path.join(PKG, "serve", "mesh_replica.py")
+    with open(path) as f:
+        src = f.read()
+    assert "GRAFTCHECK_SANCTIONED_COLLECTIVE_ENTRIES" in src
+    assert "noqa[thread-collective]" not in src
+    found = [
+        f
+        for f in lint_file(path, rules=rules_by_name(["thread-collective"]))
+        if f.rule == "thread-collective"
+    ]
+    assert found == []
+
+
 def test_thread_join_positive(tmp_path):
     src = """
     import threading

@@ -24,6 +24,7 @@ import pytest
 
 WORKER = Path(__file__).parent / "multihost_worker.py"
 SPATIAL_WORKER = Path(__file__).parent / "multihost_spatial_worker.py"
+SERVE_WORKER = Path(__file__).parent / "multihost_serve_worker.py"
 
 
 def _free_port() -> int:
@@ -183,6 +184,83 @@ def test_two_process_metrics_merge_agreement(tmp_path):
         assert r["hist_max"] == 500.0
     # byte-level agreement across ranks (deterministic summarize)
     assert two[0] == {**two[1], "pid": two[0]["pid"]}
+
+
+def test_mesh_replica_serving_bit_identical_to_single_host(tmp_path):
+    """Multi-process mesh replica (SERVING.md "Multi-process mesh
+    replica"): a 2-process logical serving replica answers /predict
+    BIT-IDENTICAL to the single-host replica stack on the same global
+    device count — across every probe size (singleton bucket, padded,
+    exact, chunked past the largest bucket) and across BOTH wire
+    encodings. Rank 1 deliberately delays its engine build: the leader's
+    distributed warmup barrier must hold serving until the straggler is
+    compiled (a leader that answered early would be a half-joined
+    replica)."""
+    two = _run_workers(
+        2, 4, str(tmp_path / "mesh"), worker=SERVE_WORKER,
+        extra_args=("serve",),
+    )
+    one = _run_workers(
+        1, 8, str(tmp_path / "single"), worker=SERVE_WORKER,
+        extra_args=("serve",),
+    )[0]
+    leader = two[0]
+    # the acceptance bar: logits bit-identical to the single-host
+    # replica (float32 round-trips JSON exactly via float64 repr)
+    assert leader["logits"] == one["logits"]
+    # both wire encodings equal the in-process answer on both stacks
+    assert leader["wire_json_equal"] and leader["wire_binary_equal"]
+    assert one["wire_json_equal"] and one["wire_binary_equal"]
+    # mesh-rounded buckets agree across topologies (same global mesh)
+    assert leader["buckets"] == one["buckets"]
+    # every rank passed the distributed warmup barrier exactly once
+    assert [r["barrier_generation"] for r in two] == [1, 1]
+    assert leader["mesh_health"]["process_count"] == 2
+    assert leader["mesh_health"]["local_devices"] == 4
+    # the bootstrap weight broadcast counts as generation 1 everywhere
+    assert all(r["engine_version"] == 1 for r in two)
+
+
+def test_mesh_replica_broadcast_swap_lands_same_generation(tmp_path):
+    """Hot-reload path: a swap submitted on the leader routes through
+    the gloo-safe broadcast — every process lands the SAME weight bytes
+    at the SAME generation, and the post-swap logits match the
+    single-host replica swapped to the same weights."""
+    two = _run_workers(
+        2, 4, str(tmp_path / "mesh"), worker=SERVE_WORKER,
+        extra_args=("swap",),
+    )
+    one = _run_workers(
+        1, 8, str(tmp_path / "single"), worker=SERVE_WORKER,
+        extra_args=("swap",),
+    )[0]
+    leader, follower = two
+    # bootstrap (gen 1) + explicit swap (gen 2), in lock-step
+    assert leader["swap_version"] == 2
+    assert leader["engine_version"] == follower["engine_version"] == 2
+    # identical served bytes on both ranks after the broadcast swap
+    assert leader["weights_psum"] == follower["weights_psum"]
+    # and the post-swap answers are bit-identical to single-host
+    assert leader["swap_logits"] == one["swap_logits"]
+
+
+def test_mesh_replica_topology_aware_aot_cache_warm_start(tmp_path):
+    """The lifted process_count==1 AOT-cache skip: entries are keyed per
+    process (process count, rank, global device assignment), every
+    import is probe-verified per process and cross-checked for
+    agreement. Cold run compiles + exports on every rank; the warm run
+    must start with compile_count == 0 and a full set of verified hits
+    on EVERY rank, bit-identical answers."""
+    out = str(tmp_path / "mesh")
+    cold = _run_workers(2, 4, out, worker=SERVE_WORKER, extra_args=("warm",))
+    warm = _run_workers(2, 4, out, worker=SERVE_WORKER, extra_args=("warm",))
+    for r in cold:
+        assert r["compiles"] == len(r["buckets"])
+        assert r["aot_hits"] == 0
+    for r in warm:
+        assert r["compiles"] == 0  # THE warm-start acceptance pin
+        assert r["aot_hits"] == len(r["buckets"])
+    assert cold[0]["logits"] == warm[0]["logits"]
 
 
 @pytest.mark.parametrize("spatial", [2, 4])

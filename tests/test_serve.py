@@ -19,6 +19,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -996,6 +997,223 @@ def test_aot_cache_mesh_engine_zero_compiles(tmp_path):
     x = _images(11, seed=4)
     np.testing.assert_array_equal(e2.predict(x), e1.predict(x))
     np.testing.assert_array_equal(e2.predict(x), e2.direct_forward(x))
+
+
+def test_aot_cache_fingerprint_is_mesh_topology_aware(tmp_path):
+    """The lifted process_count==1 cache skip (SERVING.md "Multi-process
+    mesh replica"): the entry fingerprint now carries the process span,
+    THIS process's rank, and the global device→process assignment — two
+    engines differing in ANY of those can never share an entry."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import InferenceEngine, aot_cache
+
+    p, s = _lenet_weights()
+    eng = InferenceEngine(
+        "LeNet", p, s, buckets=(8,), compute_dtype=jnp.float32,
+        mesh=make_mesh(), warmup=False,
+    )
+    key = eng._cache_key_fields(8)
+    assert key["process_count"] == 1 and key["process_index"] == 0
+    assert len(key["devices"]) == 8
+    base = aot_cache.fingerprint(key)
+    for field, value in (
+        ("process_count", 2),
+        ("process_index", 1),
+        ("devices", list(reversed(key["devices"]))),
+    ):
+        assert aot_cache.fingerprint({**key, field: value}) != base, field
+
+
+# ---------------------------------------------------------------------
+# multi-process mesh replica — single-process degenerate pins
+# (serve/mesh_replica.py; the 2-process halves live in the gloo
+# multihost suite, tests/test_multihost.py)
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_replica_pair():
+    """An 8-device mesh engine and a MeshReplica wrapping an identical
+    twin: at process_count==1 every broadcast is the identity and no
+    watchdog starts, so the replica must behave byte-for-byte like the
+    bare engine — the degenerate-mode contract the multi-process
+    protocol is built on."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import InferenceEngine, MeshReplica
+
+    p, s = _lenet_weights()
+    engine = InferenceEngine(
+        "LeNet", p, s, buckets=(1, 8, 16), compute_dtype=jnp.float32,
+        mesh=make_mesh(),
+    )
+    twin = InferenceEngine(
+        "LeNet", p, s, buckets=(1, 8, 16), compute_dtype=jnp.float32,
+        mesh=make_mesh(),
+    )
+    replica = MeshReplica(twin, timeout_s=10.0)
+    yield engine, replica
+    replica.close()
+
+
+def test_mesh_replica_degenerate_bit_identical(mesh_replica_pair):
+    """predict through the dispatch loop — padding, singleton, chunking
+    — is bit-identical to the bare engine; no extra compiles."""
+    engine, replica = mesh_replica_pair
+    assert replica.buckets == engine.buckets
+    before = replica.compile_count
+    for n in (1, 3, 8, 16, 21, 40):
+        x = _images(n, seed=300 + n)
+        assert np.array_equal(replica.predict(x), engine.predict(x)), n
+    assert replica.compile_count == before
+    assert replica.barrier_generation == 1
+
+
+def test_mesh_replica_through_micro_batcher(mesh_replica_pair):
+    """The replica sits in the engine seat of a MicroBatcher (the
+    leader's production stack): coalesced dispatches stay bit-identical
+    and the batcher's drain is bounded by the replica's advertised
+    drain_timeout_s instead of a forever-join."""
+    from pytorch_cifar_tpu.serve import MicroBatcher
+
+    engine, replica = mesh_replica_pair
+    mb = MicroBatcher(replica, max_wait_ms=1.0)
+    assert mb.shard_multiple == 8  # proxied n_devices rounds max_batch
+    futs = [mb.submit(_images(3, seed=400 + i)) for i in range(4)]
+    for i, f in enumerate(futs):
+        assert np.array_equal(
+            f.result(), engine.predict(_images(3, seed=400 + i))
+        )
+    mb.close()
+
+
+def test_mesh_replica_swap_validates_before_dispatch(mesh_replica_pair):
+    """swap_weights routes through the dispatch loop and bumps the
+    version; a wrong-model tree is rejected on the CALLER's thread
+    (nothing would be broadcast to peers) and serving continues."""
+    engine, replica = mesh_replica_pair
+    v0 = replica.version
+    params, stats = replica.weights_host()
+    assert replica.swap_weights(params, stats) == v0 + 1
+    with pytest.raises(ValueError, match="avals"):
+        replica.swap_weights({"wrong": np.zeros((2, 2), np.float32)}, {})
+    assert replica.version == v0 + 1
+    x = _images(3, seed=7)
+    assert np.array_equal(replica.predict(x), engine.predict(x))
+
+
+def test_mesh_replica_health_and_shutdown_no_thread_leak():
+    """mesh_health feeds the /healthz mesh block (half-joined replicas
+    diagnosable from a probe); close() is idempotent, rejects new work,
+    and leaves no thread behind."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import (
+        BatcherBackend,
+        InferenceEngine,
+        MeshReplica,
+        MicroBatcher,
+    )
+    from pytorch_cifar_tpu.serve.mesh_replica import MeshReplicaClosed
+
+    p, s = _lenet_weights()
+    engine = InferenceEngine(
+        "LeNet", p, s, buckets=(8,), compute_dtype=jnp.float32,
+        mesh=make_mesh(),
+    )
+    before = {t.name for t in threading.enumerate()}
+    replica = MeshReplica(engine, timeout_s=5.0)
+    mb = MicroBatcher(replica, max_wait_ms=1.0)
+    health = BatcherBackend(replica, mb).health()
+    mesh = health["mesh"]
+    assert mesh["process_count"] == 1 and mesh["local_devices"] == 8
+    assert mesh["global_devices"] == 8
+    assert mesh["barrier_generation"] == 1
+    assert mesh["timeout_s"] == 5.0
+    mb.close()
+    replica.close()
+    replica.close()  # idempotent
+    with pytest.raises(MeshReplicaClosed):
+        replica.predict(_images(1, seed=1))
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = {t.name for t in threading.enumerate()} - before
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, leaked
+
+
+def test_mesh_replica_watchdog_detection_is_bounded():
+    """The dead-peer watchdog: an armed deadline that nobody disarms
+    fires exactly once within the bound (exit_fn injected — the real one
+    is os._exit(PEER_TIMEOUT_RC), the only safe recovery from a wedged
+    gloo collective); disarm prevents it; stop joins the thread."""
+    import threading
+
+    from pytorch_cifar_tpu.serve.mesh_replica import (
+        PEER_TIMEOUT_RC,
+        _Watchdog,
+    )
+
+    fired = []
+    wd = _Watchdog(0.3, exit_fn=fired.append, interval_s=0.05)
+    wd.start()
+    wd.arm("test collective")
+    deadline = time.time() + 5.0
+    while not fired and time.time() < deadline:
+        time.sleep(0.05)
+    assert fired == [PEER_TIMEOUT_RC]
+    wd.stop()
+
+    fired2 = []
+    wd2 = _Watchdog(0.3, exit_fn=fired2.append, interval_s=0.05)
+    wd2.start()
+    wd2.arm("disarmed collective")
+    wd2.disarm()
+    time.sleep(0.6)
+    assert fired2 == []
+    wd2.stop()
+    assert not any(
+        t.name == "mesh-watchdog" for t in threading.enumerate()
+    )
+
+
+def test_mesh_replica_aot_cache_warm_start_zero_compiles(tmp_path):
+    """The warm-start pin THROUGH the replica: a second MeshReplica over
+    the same topology-aware cache imports every bucket program
+    (compile_count == 0) and answers bit-identically."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import InferenceEngine, MeshReplica
+
+    cache = str(tmp_path / "aot")
+    p, s = _lenet_weights(seed=5)
+    e1 = InferenceEngine(
+        "LeNet", p, s, buckets=(8,), compute_dtype=jnp.float32,
+        mesh=make_mesh(), aot_cache_dir=cache,
+    )
+    r1 = MeshReplica(e1, timeout_s=10.0)
+    e2 = InferenceEngine(
+        "LeNet", p, s, buckets=(8,), compute_dtype=jnp.float32,
+        mesh=make_mesh(), aot_cache_dir=cache,
+    )
+    r2 = MeshReplica(e2, timeout_s=10.0)
+    try:
+        assert e1.compile_count == 1
+        assert e2.compile_count == 0 and e2.aot_cache_hits == 1
+        x = _images(11, seed=6)
+        assert np.array_equal(r2.predict(x), r1.predict(x))
+    finally:
+        r1.close()
+        r2.close()
 
 
 def test_aot_cache_probe_mismatch_poisons_and_recompiles(tmp_path):

@@ -984,6 +984,65 @@ def test_ckpt_inspect_verifies_v2_and_v3_and_flags_corruption(tmp_path):
     assert _inspect(tmp_path / "nope").returncode == 2
 
 
+def test_ckpt_inspect_surfaces_mesh_topology(tmp_path):
+    """Mesh-topology awareness (SERVING.md "Multi-process mesh
+    replica"): a v3 checkpoint's shard count is reported as the saving
+    process span, and AOT-cache sidecars are grouped by (model, bucket,
+    process span) with the ranks present — a multi-process group missing
+    a rank's entry is flagged HALF-POPULATED, the on-disk trace of a
+    half-joined replica."""
+    import jax
+
+    from pytorch_cifar_tpu.models import create_model
+    from pytorch_cifar_tpu.train.checkpoint import save_checkpoint
+    from pytorch_cifar_tpu.train.optim import make_optimizer
+    from pytorch_cifar_tpu.train.state import create_train_state
+
+    state = create_train_state(
+        create_model("LeNet"), jax.random.PRNGKey(0),
+        make_optimizer(lr=0.1, t_max=2, steps_per_epoch=2),
+    )
+    out = tmp_path / "ckpt"
+    save_checkpoint(str(out), state, 3, 30.0, num_shards=2)
+
+    # plant AOT-cache sidecars for a 2-process topology: bucket 8 has
+    # both ranks, bucket 16 only rank 0 (the half-joined trace). The
+    # payloads don't matter to topology reporting — only the sidecars.
+    def sidecar(name, bucket, rank, poisoned=False):
+        (out / name).write_text(json.dumps({
+            "manifest": {"format": 2, "crc32": 0, "size": 0},
+            "key": {
+                "model": "LeNet", "bucket": bucket,
+                "process_count": 2, "process_index": rank,
+                "devices": [f"p0:0", f"p1:0"],
+            },
+            "poisoned": poisoned,
+        }))
+
+    sidecar("LeNet_b8_aaaa.aotx.json", 8, 0)
+    sidecar("LeNet_b8_bbbb.aotx.json", 8, 1)
+    sidecar("LeNet_b16_cccc.aotx.json", 16, 0, poisoned=True)
+
+    r = _inspect(out, "--json")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    # v3 topology: 2 shards == saved by a 2-process mesh
+    (ck,) = [c for c in rep["checkpoints"] if c["name"] == "ckpt.msgpack"]
+    assert ck["format"] == 3 and ck["saved_process_count"] == 2
+    # AOT groups: full vs half-populated, poisoned surfaced
+    groups = {g["bucket"]: g for g in rep["aot_cache"]["entries"]}
+    assert groups[8]["processes_present"] == [0, 1]
+    assert groups[8]["half_populated"] is False
+    assert groups[16]["processes_present"] == [0]
+    assert groups[16]["half_populated"] is True
+    assert rep["aot_cache"]["half_populated"] == ["LeNet bucket 16"]
+    assert rep["aot_cache"]["poisoned"] == ["LeNet_b16_cccc.aotx"]
+    # the human-readable report names the half-joined trace
+    r = _inspect(out)
+    assert "HALF-POPULATED" in r.stdout
+    assert "2-process mesh" in r.stdout
+
+
 def test_ckpt_inspect_quarantine_and_staging_awareness(tmp_path):
     """Canary-pipeline awareness (ROBUSTNESS.md "canary promotion"): a
     quarantine tombstone in a STAGING dir is routine evidence (exit 0,

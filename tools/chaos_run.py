@@ -48,6 +48,18 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
              checkpoint B must auto-promote (live epoch/generation
              advance, the watcher hot-loads it) with zero failed client
              requests across the whole drill.
+  mesh     — cross-host drill (SERVING.md "Multi-process mesh
+             replica"): a 2-replica fleet where each LOGICAL replica
+             spans 2 processes (leader + follower over a shared gloo
+             mesh); one follower is SIGKILLed under mixed-wire load.
+             The leader must detect the dead collective peer within the
+             watchdog bound and exit rc 70 (never hang), the router
+             must evict the logical replica and hedge the in-flight
+             requests to the survivor (ZERO client-visible errors), and
+             /predict must be bit-identical across both mesh replicas,
+             a single-host reference replica, and the router over both
+             wire encodings; the warm replica joins with compile_count
+             == 0 from the topology-aware AOT cache.
   zoo      — multi-tenant fleet drill (SERVING.md "Multi-tenant zoo
              serving"): a 2-replica zoo fleet (3 models, max_resident=2
              so the tail tenant forces eviction churn) serves a skewed
@@ -617,6 +629,269 @@ def router_drill(args, work: str) -> dict:
         # in-flight requests the kill would have lost without rerouting
         "router_hedged": rec_run["router"]["hedged"],
         "router_replica_errors": rec_run["router"]["replica_errors"],
+        "router_rc": proc.returncode,
+    }
+
+
+def mesh_drill(args, work: str) -> dict:
+    """The cross-host drill (SERVING.md "Multi-process mesh replica"):
+    SIGKILL one FOLLOWER of a live 2-process mesh replica under load.
+
+    Phases:
+      0. fleet-up: router_run --replicas 2 --mesh_procs 2 (each logical
+         replica = a leader + a follower rank, 2 forced CPU devices per
+         rank -> a 4-device global mesh per replica; shared AOT cache so
+         replica 1 joins with compile_count == 0 on EVERY rank) + one
+         standalone single-host 1-device serve.py as the bit-identity
+         reference.
+      1. bits: the same payload over BOTH wire encodings to replica 0's
+         leader, replica 1's leader, the single-host reference, and the
+         router — all byte-equal (the mesh-replica acceptance bar).
+      2. steady state: closed-loop mixed-wire HTTP load on the router.
+      3. kill: replica 0's rank-1 follower is SIGKILLed mid-load. The
+         leader must detect the dead collective peer within the
+         --mesh_timeout_s bound and exit rc 70 (never hang); the router
+         must evict the LOGICAL replica; hedges absorb the in-flight
+         loss — ZERO client-visible errors.
+      4. post-evict load on the survivor, then SIGTERM drain: router
+         exits 0, exit codes prove who died of what (leader rc 70,
+         follower -9, replica 1 clean).
+    """
+    import threading
+    import urllib.request
+
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+    from pytorch_cifar_tpu.serve.mesh_replica import PEER_TIMEOUT_RC
+
+    ckpt_dir = os.path.join(work, "ckpt")
+    print(f"==> [mesh] training checkpoint -> {ckpt_dir}", file=sys.stderr)
+    run_to_completion(train_cmd(args, ckpt_dir), child_env(), args.timeout)
+
+    mesh_timeout_s = 6.0
+    env = child_env()
+    # 2 forced CPU devices per RANK: a 2-process x 2-device global mesh
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "router_run.py"),
+        "--ckpt", ckpt_dir,
+        "--model", args.model,
+        "--replicas", "2",
+        "--mesh_procs", "2",
+        "--mesh_timeout_s", str(mesh_timeout_s),
+        "--buckets", "1", "4", "8",
+        "--aot_cache", os.path.join(work, "aot"),
+        "--deadline_ms", "2000",
+        "--probe_s", "0.2",
+        "--max_wait_ms", "1",
+    ]
+    print("==> [mesh] fleet up (2 logical replicas x 2 processes)",
+          file=sys.stderr)
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+
+    leader_re = re.compile(r"==> replica (\d+) pid=(\d+) url=(\S+)")
+    follower_re = re.compile(
+        r"==> replica (\d+) follower rank=(\d+) pid=(\d+)"
+    )
+    router_re = re.compile(r"==> router: serving on (\S+)")
+    leaders, followers = {}, {}
+    router_url = None
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"router_run exited rc={proc.returncode} before the "
+                    "router came up"
+                )
+            time.sleep(0.05)
+            continue
+        sys.stderr.write(line)
+        m = leader_re.search(line)
+        if m:
+            leaders[int(m.group(1))] = (int(m.group(2)), m.group(3))
+        m = follower_re.search(line)
+        if m:
+            followers[int(m.group(1))] = int(m.group(3))
+        m = router_re.search(line)
+        if m:
+            router_url = m.group(1)
+            break
+    if router_url is None or len(leaders) != 2 or len(followers) != 2:
+        proc.kill()
+        raise SystemExit("timed out waiting for the mesh fleet topology")
+    drain_t = threading.Thread(
+        target=lambda: [sys.stderr.write(ln) for ln in proc.stderr],
+        name="router-stderr-drain", daemon=True,
+    )
+    drain_t.start()
+
+    # the single-host bit-identity reference: one plain 1-device replica
+    ref_env = child_env()
+    ref_env.pop("XLA_FLAGS", None)
+    ref = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "serve.py"),
+            "--ckpt", ckpt_dir, "--model", args.model,
+            "--buckets", "1", "4", "8", "--http_port", "0",
+        ],
+        env=ref_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+    seen = _wait_for_stderr(ref, "==> http: serving on", args.timeout)
+    ref_url = re.search(r"==> http: serving on (\S+)", seen).group(1)
+    ref_drain = threading.Thread(
+        target=lambda: [None for _ in ref.stderr],
+        name="ref-stderr-drain", daemon=True,
+    )
+    ref_drain.start()
+
+    def healthz(url):
+        with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+            return json.load(r)
+
+    h1 = healthz(leaders[1][1])
+    warm_compiles = int(h1.get("compiles", -1))
+    mesh_block = h1.get("mesh") or {}
+
+    # bit-identity across the fleet, the single-host reference, and the
+    # router — over BOTH wire encodings
+    probe = np.random.RandomState(7).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    outs = [
+        HttpTarget(u, wire=w).submit(probe).result()
+        for u in (leaders[0][1], leaders[1][1], ref_url, router_url)
+        for w in ("json", "binary")
+    ]
+    bit_identical = all(np.array_equal(outs[0], o) for o in outs[1:])
+
+    def load_phase(tag, duration_s, seed):
+        rep = run_load(
+            HttpTarget(router_url, wire="mixed"),
+            clients=4,
+            requests_per_client=10**6,
+            images_max=4,
+            seed=seed,
+            duration_s=duration_s,
+            bulk_fraction=0.0,  # the ZERO-client-visible-errors bar:
+            # bulk 429s propagate by design, so the drill load is all
+            # interactive (hedged transparently through the kill)
+        )
+        print(
+            f"==> [mesh] {tag}: {rep['requests']} reqs "
+            f"p99={rep['p99_ms']:.1f}ms hedged={rep['hedged']} "
+            f"failed={rep['failed']}", file=sys.stderr,
+        )
+        return rep
+
+    print("==> [mesh] phase 1: steady state", file=sys.stderr)
+    steady = load_phase("steady", 5.0, seed=1)
+
+    print(
+        f"==> [mesh] phase 2: SIGKILL replica 0 follower "
+        f"(pid {followers[0]}) under load", file=sys.stderr,
+    )
+    # bounded detection: the leader's watchdog must turn the dead peer
+    # into a process exit (probe-visible as connection-refused) within
+    # the timeout — never a hang. Measured by a poller that starts the
+    # moment the SIGKILL lands, concurrent with the load phase.
+    detection = {"s": None}
+
+    def kill_and_time_detection():
+        t0 = time.monotonic()
+        os.kill(followers[0], signal.SIGKILL)
+        deadline_d = t0 + mesh_timeout_s + 10.0
+        while time.monotonic() < deadline_d:
+            try:
+                healthz(leaders[0][1])
+                time.sleep(0.25)
+            except (OSError, ValueError):
+                detection["s"] = time.monotonic() - t0
+                return
+
+    kill_at = threading.Timer(2.0, kill_and_time_detection)
+    kill_at.start()
+    killed = load_phase("kill", 4.0 + 2.0 * mesh_timeout_s, seed=2)
+    kill_at.join()
+    detection_s = detection["s"]
+
+    print("==> [mesh] phase 3: post-evict steady state", file=sys.stderr)
+    post = load_phase("post-evict", 5.0, seed=3)
+
+    router_health = healthz(router_url)
+    healthy_after = int(router_health.get("healthy_replicas", -1))
+
+    print("==> [mesh] phase 4: drain", file=sys.stderr)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=args.timeout)
+    drain_t.join(timeout=10)
+    ref.send_signal(signal.SIGTERM)
+    ref.communicate(timeout=60)
+    ref_drain.join(timeout=10)
+    rec_run = None
+    for ln in out.splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                rec_run = json.loads(ln)
+            except ValueError:
+                continue
+    if rec_run is None:
+        raise SystemExit("router_run printed no JSON record")
+
+    leader_rc = rec_run["replica_rcs"][0]
+    follower_rcs = rec_run["follower_rcs"]
+    ok = (
+        proc.returncode == 0
+        and warm_compiles == 0
+        and mesh_block.get("process_count") == 2
+        and mesh_block.get("barrier_generation") == 1
+        and bit_identical
+        and steady["requests"] > 0
+        and killed["requests"] > 0
+        and post["requests"] > 0
+        # THE bar: zero client-visible errors in every phase — the
+        # router's hedge absorbs the logical replica's death
+        and steady["failed"] == 0
+        and killed["failed"] == 0
+        and post["failed"] == 0
+        and detection_s is not None
+        and leader_rc == PEER_TIMEOUT_RC
+        and follower_rcs[0][0] == -int(signal.SIGKILL)
+        and rec_run["replica_rcs"][1] == 0
+        and follower_rcs[1][0] == 0
+        and healthy_after == 1
+        and rec_run["router"]["evictions"] >= 1
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "mesh",
+        "match": ok,
+        "mesh_procs": 2,
+        "mesh_timeout_s": mesh_timeout_s,
+        "reference_s": round(steady["elapsed_s"], 2),
+        # dead-peer detection: follower SIGKILL -> leader exit, as seen
+        # by a health probe (the router's eviction signal)
+        "detection_s": round(detection_s, 2) if detection_s else None,
+        "warm_replica_compiles": warm_compiles,
+        "mesh_health": mesh_block,
+        "bit_identical": bit_identical,
+        "wire": "mixed",
+        "requests": steady["requests"] + killed["requests"]
+        + post["requests"],
+        "failed": steady["failed"] + killed["failed"] + post["failed"],
+        "hedged_during_kill": killed["hedged"],
+        "p99_steady_ms": round(steady["p99_ms"], 2),
+        "p99_post_ms": round(post["p99_ms"], 2),
+        "leader_rc": leader_rc,
+        "follower_rcs": follower_rcs,
+        "healthy_after": healthy_after,
+        "evictions": rec_run["router"]["evictions"],
+        "router_hedged": rec_run["router"]["hedged"],
         "router_rc": proc.returncode,
     }
 
@@ -1323,7 +1598,7 @@ def main() -> int:
         "--mode",
         choices=(
             "sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt",
-            "router", "canary", "zoo",
+            "router", "canary", "zoo", "mesh",
         ),
         default="sigterm",
     )
@@ -1369,13 +1644,14 @@ def main() -> int:
 
     work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
 
-    if args.mode in ("serve", "ckpt", "router", "canary", "zoo"):
+    if args.mode in ("serve", "ckpt", "router", "canary", "zoo", "mesh"):
         record = {
             "serve": serve_drill,
             "ckpt": ckpt_drill,
             "router": router_drill,
             "canary": canary_drill,
             "zoo": zoo_drill,
+            "mesh": mesh_drill,
         }[args.mode](args, work)
         print(json.dumps(record))
         if record["match"] and not args.out:

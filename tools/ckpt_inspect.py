@@ -108,6 +108,11 @@ def inspect_candidate(ckpt_dir, name):
         }
     if shards:
         rep["format"] = 3
+        # v3 publish topology: one shard per saving process, so the
+        # shard count IS the process span of the mesh that wrote it —
+        # the offline half of the topology diagnosis (/healthz's `mesh`
+        # block is the serving-time half)
+        rep["saved_process_count"] = len(shards)
         parts = []
         for s in shards:
             blob, probs = _verify_bytes(
@@ -147,6 +152,67 @@ def inspect_candidate(ckpt_dir, name):
             )
     rep["ok"] = not rep["problems"] or rep["format"] == 1
     return rep
+
+
+def inspect_aot_cache(ckpt_dir):
+    """AOT executable-cache entries in this dir (``*.aotx`` + sidecar;
+    serve/aot_cache.py), grouped by (model, bucket, process span) with
+    the ranks that actually exported one. A multi-process group missing
+    some rank's entry is HALF-POPULATED — the trace a half-joined mesh
+    replica leaves behind (one rank compiled+exported, a peer never got
+    there), and the reason the next launch will compile everywhere (the
+    cross-process agreement imports a bucket only when EVERY rank holds
+    a verified entry — SERVING.md "Multi-process mesh replica")."""
+    groups = {}
+    poisoned = []
+    for p in sorted(glob.glob(os.path.join(ckpt_dir, "*.aotx.json"))):
+        meta = _load_json(p) or {}
+        key = meta.get("key") or {}
+        name = os.path.basename(p)[: -len(".json")]
+        if meta.get("poisoned"):
+            poisoned.append(name)
+        gk = (
+            str(key.get("model")),
+            int(key.get("bucket", -1)),
+            int(key.get("process_count", 1)),
+        )
+        g = groups.setdefault(
+            gk,
+            {
+                "model": gk[0],
+                "bucket": gk[1],
+                "process_count": gk[2],
+                "processes_present": set(),
+                "devices_per_process": None,
+            },
+        )
+        g["processes_present"].add(int(key.get("process_index", 0)))
+        n_dev = len(key.get("devices") or [])
+        if n_dev and gk[2]:
+            g["devices_per_process"] = n_dev // gk[2] or n_dev
+    out = []
+    for g in groups.values():
+        present = sorted(g["processes_present"])
+        out.append(
+            {
+                **g,
+                "processes_present": present,
+                "half_populated": (
+                    g["process_count"] > 1
+                    and len(present) < g["process_count"]
+                ),
+            }
+        )
+    out.sort(key=lambda g: (g["model"], g["bucket"], g["process_count"]))
+    return {
+        "entries": out,
+        "poisoned": poisoned,
+        "half_populated": [
+            f"{g['model']} bucket {g['bucket']}"
+            for g in out
+            if g["half_populated"]
+        ],
+    }
 
 
 def inspect_dir(ckpt_dir):
@@ -191,6 +257,7 @@ def inspect_dir(ckpt_dir):
         n: history_names(ckpt_dir, n) for n in primaries
     }
     corrupt = [r["name"] for r in reports if not r["ok"]]
+    aot = inspect_aot_cache(ckpt_dir)
     staging = is_staging_dir(ckpt_dir)
     quarantined = [
         r["name"]
@@ -204,6 +271,11 @@ def inspect_dir(ckpt_dir):
         "orphan_shards": orphans,
         "history": history,
         "corrupt": corrupt,
+        # AOT executable-cache topology (SERVING.md "Multi-process mesh
+        # replica"): per-(model, bucket, process-span) entry groups with
+        # the ranks present — a half-populated multi-process group is
+        # the on-disk trace of a half-joined mesh replica
+        "aot_cache": aot,
         "quarantined": quarantined,
         # a rejected checkpoint sitting in a LIVE dir is one watcher poll
         # from serving: the operator error this tool exists to catch
@@ -232,7 +304,10 @@ def main(argv=None) -> int:
         for r in report["checkpoints"]:
             status = "OK" if r["ok"] else "CORRUPT"
             extra = (
-                f" ({len(r['shards'])} shards)" if r["shards"] else ""
+                f" ({len(r['shards'])} shards — saved by a "
+                f"{r['saved_process_count']}-process mesh)"
+                if r["shards"]
+                else ""
             )
             if r.get("promotion_generation") is not None:
                 extra += f" [promotion gen {r['promotion_generation']}]"
@@ -250,6 +325,25 @@ def main(argv=None) -> int:
                 print(f"  ! {kind}: {q.get('reason')}")
         for o in report["orphan_shards"]:
             print(f"orphan shard (torn publish, invisible to restore): {o}")
+        for g in report["aot_cache"]["entries"]:
+            span = (
+                f"{len(g['processes_present'])}/{g['process_count']} "
+                f"processes"
+                if g["process_count"] > 1
+                else "single-process"
+            )
+            note = (
+                " — HALF-POPULATED (a rank never exported: half-joined "
+                "mesh replica trace; next launch compiles everywhere)"
+                if g["half_populated"]
+                else ""
+            )
+            print(
+                f"aot cache: {g['model']} bucket {g['bucket']} "
+                f"[{span}]{note}"
+            )
+        for p in report["aot_cache"]["poisoned"]:
+            print(f"aot cache: {p} POISONED (probe-refuted; see sidecar)")
         if report["quarantined_as_live"]:
             print(
                 "verdict: QUARANTINED-AS-LIVE — a rejected checkpoint "

@@ -54,13 +54,18 @@ URL_RE = re.compile(r"==> http: serving on (http://\S+)")
 
 
 class ReplicaProc:
-    """One spawned serve.py replica: the process, a stderr-pump thread
-    (forwards lines with a ``[replica i]`` prefix and captures the
-    frontend URL), and the parsed URL."""
+    """One spawned serve.py replica process: the process, a stderr-pump
+    thread (forwards lines with a ``[replica i]`` prefix and captures
+    the frontend URL), and the parsed URL. For a multi-process mesh
+    replica this wraps the LEADER rank; the follower ranks ride along in
+    ``followers`` (their own ReplicaProcs, never expected to print a
+    URL) so drain and exit-code collection see the whole logical
+    replica."""
 
-    def __init__(self, idx: int, proc: subprocess.Popen):
+    def __init__(self, idx, proc: subprocess.Popen, followers=()):
         self.idx = idx
         self.proc = proc
+        self.followers = list(followers)
         # url is written by the pump thread and read by the launcher
         # thread: guarded by _lock, signalled by _url_ready
         self._lock = threading.Lock()
@@ -94,6 +99,14 @@ class ReplicaProc:
         self._thread.join(timeout=10)
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def spawn_replica(args, idx: int) -> ReplicaProc:
     cmd = [
         sys.executable, os.path.join(REPO, "serve.py"),
@@ -119,15 +132,41 @@ def spawn_replica(args, idx: int) -> ReplicaProc:
         cmd.append("--watch")
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    proc = subprocess.Popen(
-        cmd,
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-        cwd=REPO,
-    )
-    return ReplicaProc(idx, proc)
+
+    def popen(extra):
+        return subprocess.Popen(
+            cmd + extra,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO,
+        )
+
+    if args.mesh_procs <= 1:
+        return ReplicaProc(idx, popen([]))
+    # multi-process mesh replica (SERVING.md "Multi-process mesh
+    # replica"): one LOGICAL replica from N serve.py ranks on a private
+    # coordinator port. The leader (rank 0) owns the frontend and emits
+    # the ready line; followers join the rendezvous and run the
+    # lock-step loop — the router only ever sees the leader's URL.
+    coord = f"127.0.0.1:{_free_port()}"
+    mesh = [
+        "--mesh_procs", str(args.mesh_procs),
+        "--mesh_coord", coord,
+        "--mesh_timeout_s", str(args.mesh_timeout_s),
+        "--num_devices", "0",  # every rank contributes all its devices
+    ]
+    leader = popen(mesh + ["--mesh_rank", "0"])
+    followers = []
+    for rank in range(1, args.mesh_procs):
+        fp = popen(mesh + ["--mesh_rank", str(rank)])
+        followers.append(ReplicaProc(f"{idx}:r{rank}", fp))
+        print(
+            f"==> replica {idx} follower rank={rank} pid={fp.pid}",
+            file=sys.stderr,
+        )
+    return ReplicaProc(idx, leader, followers=followers)
 
 
 def wait_healthy(replica: ReplicaProc, timeout: float) -> dict:
@@ -163,24 +202,39 @@ def wait_healthy(replica: ReplicaProc, timeout: float) -> dict:
     raise SystemExit(f"replica {replica.idx} never became healthy")
 
 
+def _reap(r: ReplicaProc, timeout: float) -> int:
+    try:
+        r.proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        r.proc.kill()
+        r.proc.wait()
+    # drain the replica's stdout (its one JSON line) and stderr pump
+    if r.proc.stdout is not None:
+        r.proc.stdout.read()
+    r.join_pump()
+    return r.proc.returncode
+
+
 def shutdown_replicas(replicas, timeout: float) -> list:
     """SIGTERM every live replica (their drain signal), collect exit
-    codes; a replica the chaos drill SIGKILLed is already gone."""
+    codes; a replica the chaos drill SIGKILLed is already gone.
+
+    Mesh replicas drain LEADER-FIRST (SERVING.md "Multi-process mesh
+    replica"): the leader's SIGTERM handler drains its frontend and
+    batcher, then broadcasts shutdown so the follower loops return on
+    their own — a follower is only TERMed directly (it ignores the
+    signal; kill is the backstop) after its leader has been reaped."""
     for r in replicas:
         if r.proc.poll() is None:
             r.proc.send_signal(signal.SIGTERM)
     codes = []
     for r in replicas:
-        try:
-            r.proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            r.proc.kill()
-            r.proc.wait()
-        # drain the replica's stdout (its one JSON line) and stderr pump
-        if r.proc.stdout is not None:
-            r.proc.stdout.read()
-        r.join_pump()
-        codes.append(r.proc.returncode)
+        codes.append(_reap(r, timeout))
+        r.follower_rcs = []
+        for f in r.followers:
+            if f.proc.poll() is None:
+                f.proc.send_signal(signal.SIGTERM)
+            r.follower_rcs.append(_reap(f, timeout))
     return codes
 
 
@@ -205,6 +259,20 @@ def main() -> int:
     p.add_argument(
         "--replica_devices", type=int, default=1, dest="replica_devices",
         help="devices per replica mesh (serve.py --num_devices)",
+    )
+    p.add_argument(
+        "--mesh_procs", type=int, default=1,
+        help="processes per LOGICAL replica (SERVING.md 'Multi-process "
+        "mesh replica'): each replica is launched as one leader rank "
+        "(owns the frontend; the router sees only its URL) plus N-1 "
+        "follower ranks on a private coordinator port; SIGTERM drains "
+        "leader-first. 1 = single-process replicas exactly as before",
+    )
+    p.add_argument(
+        "--mesh_timeout_s", type=float, default=30.0,
+        help="dead-peer detection bound per rank (serve.py "
+        "--mesh_timeout_s): a rank stuck at a collective this long "
+        "exits rc 70 so the router can evict the logical replica",
     )
     p.add_argument(
         "--aot_cache", default="",
@@ -319,11 +387,16 @@ def main() -> int:
         "replicas": args.replicas,
         "model": args.model,
         "models": args.models,
+        "mesh_procs": args.mesh_procs,
         "router_url": frontend.url,
         "replica_compiles": [h.get("compiles") for h in healths],
         "replica_aot_hits": [h.get("aot_cache_hits") for h in healths],
         "replica_cold_start_s": [h.get("cold_start_s") for h in healths],
+        "replica_mesh": [h.get("mesh") for h in healths],
         "replica_rcs": replica_rcs,
+        "follower_rcs": [
+            getattr(r, "follower_rcs", []) for r in replicas
+        ],
         **{
             k: (round(v, 3) if isinstance(v, float) else v)
             for k, v in report.items()
