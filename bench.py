@@ -1348,6 +1348,188 @@ def serve_mesh_bench(args) -> int:
     return 0
 
 
+def serve_elastic_bench(args) -> int:
+    """``--serve-elastic``: the elastic-fleet A/B (SERVING.md "Elastic
+    fleet"). Two fleet_run.py children serve the same closed-loop ramp:
+
+    - **fixed**: ``--min_replicas 1 --max_replicas 1`` — the pre-PR
+      world, one replica no matter the load (and the run that populates
+      the shared AOT cache, so the elastic run's scale-up is the warm
+      production path).
+    - **elastic**: ``--min_replicas 1 --max_replicas 2`` — the
+      controller must scale up under the ramp; the headline ``value``
+      is the REACTION TIME in seconds from pressure onset (the ramp's
+      first request) to the scale-up replica serving (the controller's
+      ``scale-up`` line, which it prints only after ``/healthz`` went
+      green and the router registered the replica), with the warm-start
+      pin: the new replica joins with ``compile_count == 0``.
+
+    ``elastic_vs_fixed`` is throughput during the SAME ramp window —
+    on a 1-core container both fleets share one CPU so the ratio prices
+    scheduling overhead, not real capacity; BENCHMARKS.md records the
+    honest reading either way. Like headline()/serve_mesh_bench(), this
+    parent never initializes a jax backend."""
+    import re as _re
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="bench_elastic_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # replicas: production 1-device shape
+
+    ckpt = os.path.join(work, "ckpt")
+    print(
+        f"==> [elastic] training tiny checkpoint -> {ckpt}",
+        file=sys.stderr,
+    )
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(here, "train.py"),
+            "--model", args.model, "--synthetic_data",
+            "--synthetic_train_size", "256", "--synthetic_test_size", "64",
+            "--batch_size", "64", "--epochs", "1", "--output_dir", ckpt,
+            "--async_save", "off",
+        ],
+        env=env, capture_output=True, text=True, timeout=900, cwd=here,
+    )
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise SystemExit("elastic bench: training the checkpoint failed")
+
+    fleet_re = _re.compile(r"==> fleet: serving on (\S+)")
+    up_re = _re.compile(
+        r"==> fleet: scale-up replica \d+ url=\S+ pid=\d+ compiles=(\S+)"
+    )
+
+    def run_fleet(tag, max_replicas, ramp_s):
+        cmd = [
+            sys.executable, os.path.join(here, "tools", "fleet_run.py"),
+            "--ckpt", ckpt,
+            "--model", args.model,
+            "--min_replicas", "1",
+            "--max_replicas", str(max_replicas),
+            "--buckets", "1", "4", "8",
+            "--aot_cache", os.path.join(work, "aot"),
+            "--max_wait_ms", "1",
+            "--probe_s", "0.2",
+            "--control_interval_s", "0.25",
+            "--queue_high", "3",
+            "--queue_low", "2",
+            "--up_after_s", "0.5",
+            "--up_cooldown_s", "1",
+            "--down_after_s", "30",  # no shed inside the window
+            "--down_cooldown_s", "30",
+        ]
+        print(
+            f"==> [elastic] {tag} fleet up (max {max_replicas})",
+            file=sys.stderr,
+        )
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, cwd=here,
+        )
+        state = {"url": None, "scaleup_at": None, "compiles": None}
+        ready = threading.Event()
+
+        def watch():
+            for line in proc.stderr:
+                sys.stderr.write(line)
+                m = fleet_re.search(line)
+                if m:
+                    state["url"] = m.group(1)
+                    ready.set()
+                m = up_re.search(line)
+                if m and state["scaleup_at"] is None:
+                    state["scaleup_at"] = time.perf_counter()
+                    state["compiles"] = m.group(1)
+            ready.set()  # EOF unblocks the waiter on a crash
+
+        watcher = threading.Thread(
+            target=watch, name=f"fleet-watch-{tag}", daemon=True
+        )
+        watcher.start()
+        if not ready.wait(600) or state["url"] is None:
+            proc.kill()
+            proc.communicate()
+            raise SystemExit(f"elastic bench: {tag} fleet never came up")
+        t_onset = time.perf_counter()
+        report = run_load(
+            HttpTarget(state["url"]),
+            clients=8,
+            requests_per_client=10**6,
+            images_max=4,
+            seed=0,
+            duration_s=ramp_s,
+        )
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=300)
+        watcher.join(timeout=10)
+        rec = parse_child_record(out) or {}
+        # fleet_run's record has no 'metric' key; parse it directly
+        for ln in out.splitlines():
+            s = ln.strip()
+            if s.startswith("{"):
+                try:
+                    cand = json.loads(s)
+                except ValueError:
+                    continue
+                if cand.get("harness") == "fleet_run":
+                    rec = cand
+        reaction_s = (
+            state["scaleup_at"] - t_onset
+            if state["scaleup_at"] is not None
+            else None
+        )
+        return report, rec, reaction_s, state["compiles"]
+
+    ramp_s = max(12.0, args.steps * 2.0)
+    fixed_report, fixed_rec, _, _ = run_fleet("fixed", 1, ramp_s)
+    el_report, el_rec, reaction_s, up_compiles = run_fleet(
+        "elastic", 2, ramp_s
+    )
+    if reaction_s is None:
+        raise SystemExit(
+            "elastic bench: the controller never scaled up under the "
+            "ramp — no reaction time to report"
+        )
+
+    rec = core_record(
+        f"serve_elastic_scaleout_{args.model}_cpu",
+        round(reaction_s, 3),
+        unit="seconds",
+    )
+    rec.update(
+        ramp_s=ramp_s,
+        ramp_clients=8,
+        # the warm-start pin: the scale-up replica imported the cache
+        # the fixed run populated
+        scaleup_compiles=int(up_compiles),
+        scale_ups=el_rec.get("scale_ups"),
+        spawn_ms_p50=el_rec.get("spawn_ms_p50"),
+        elastic_img_per_sec=round(float(el_report["img_per_sec"]), 2),
+        fixed_img_per_sec=round(float(fixed_report["img_per_sec"]), 2),
+        elastic_vs_fixed=round(
+            float(el_report["img_per_sec"])
+            / max(float(fixed_report["img_per_sec"]), 1e-9),
+            4,
+        ),
+        elastic_p99_ms=round(float(el_report["p99_ms"]), 2),
+        fixed_p99_ms=round(float(fixed_report["p99_ms"]), 2),
+        failed=el_report["failed"] + fixed_report["failed"],
+        requests=el_report["requests"] + fixed_report["requests"],
+    )
+    print(json.dumps(rec))
+    shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
 def headline(args) -> int:
     """The default scoreboard protocol: median of ``--captures`` fresh
     subprocess runs of the production epoch path, plus one ``--step``
@@ -1501,6 +1683,15 @@ def main() -> int:
         "topology-aware AOT cache with zero compiles on every rank",
     )
     parser.add_argument(
+        "--serve-elastic", action="store_true", dest="serve_elastic",
+        help="measure the elastic fleet (serve/fleet.py, SERVING.md "
+        "'Elastic fleet'): scale-out REACTION TIME (pressure onset -> "
+        "the controller's new replica serving, warm from the shared "
+        "AOT cache) as the headline value, plus the "
+        "throughput-during-ramp A/B vs a fixed 1-replica fleet "
+        "(elastic_vs_fixed) in the single-line record",
+    )
+    parser.add_argument(
         "--serve-zoo", action="store_true", dest="serve_zoo",
         help="measure multi-tenant zoo serving (serve/tenancy.py, "
         "SERVING.md 'Multi-tenant zoo serving'): per-model img/s under "
@@ -1547,6 +1738,11 @@ def main() -> int:
     if args.serve_mesh:
         # multi-process orchestration: the serve ranks own the devices
         return serve_mesh_bench(args)
+
+    if args.serve_elastic:
+        # fleet orchestration: replicas own the devices; this parent
+        # moves bytes, watches the controller, and times its reaction
+        return serve_elastic_bench(args)
 
     if not (
         args.pipeline

@@ -105,6 +105,30 @@ class TrainConfig:
     # parallelism
     num_devices: int = 0  # 0 = all local devices, data-parallel mesh
     distributed: bool = False  # multi-host: jax.distributed.initialize()
+    # explicit multi-host rendezvous (CPU/GPU testing and the elastic
+    # supervisor; on TPU pods leave empty — the runtime discovers
+    # coordinator/world/rank itself): "host:port", world size, rank
+    dist_coord: str = ""
+    dist_procs: int = 0
+    dist_rank: int = 0
+    # elastic training (train/elastic.py; ROADMAP item 3).
+    #   elastic       — THIS RANK runs under an elastic supervisor: on
+    #                   resume, process 0 re-cuts the on-disk checkpoint
+    #                   layout to the current world size
+    #                   (checkpoint.reshard_to_world — a v3 save by M
+    #                   processes restores into any N-world already;
+    #                   this keeps the dir's layout canonical), and a
+    #                   mid-fit failure in a multi-process world exits
+    #                   with the elastic reshape code (75) so the
+    #                   supervisor relaunches the surviving world with
+    #                   --resume instead of declaring the run dead.
+    #   elastic_procs — supervisor mode for train.py: spawn this many
+    #                   ranks under train.elastic.ElasticTrainRunner,
+    #                   which turns a preempted (or added) host into a
+    #                   terminate → relaunch-at-new-world-size → resume
+    #                   cycle from the last durable checkpoint. 0 = off.
+    elastic: bool = False
+    elastic_procs: int = 0
     # cross-replica BatchNorm: pmean batch moments over the data axis so
     # normalization uses global-batch statistics. Default off = the
     # reference's per-replica BN under DDP (SURVEY.md §7.2; no SyncBN

@@ -1,4 +1,4 @@
-"""graftcheck rules: 16 JAX/concurrency invariants this repo has bled for.
+"""graftcheck rules: 17 JAX/concurrency invariants this repo has bled for.
 
 Every rule is grounded in a failure mode from this repo's own history
 (STATIC_ANALYSIS.md has the catalog with one real-world example each).
@@ -1822,7 +1822,183 @@ class ThreadJoin(Rule):
 
 
 # ---------------------------------------------------------------------
-# 12-15. concurrency-protocol rules (lint/locks.py: the lock-effect
+# 12. subprocess-lifecycle
+# ---------------------------------------------------------------------
+
+
+class SubprocessLifecycle(Rule):
+    name = "subprocess-lifecycle"
+    summary = (
+        "a subprocess.Popen whose handle is never waited/terminated and "
+        "never handed to an owner — an orphan child outlives its parent "
+        "(the zombie-replica shape the elastic fleet controller's "
+        "decommission path must never produce: a drained process must "
+        "ALWAYS be reaped, a spawned one always owned); wait()/"
+        "communicate() reap, kill()/terminate() end, escape to an owner "
+        "transfers the obligation"
+    )
+
+    # calls that discharge the obligation on a handle: reaping (wait/
+    # communicate) or termination (kill/terminate — their call sites in
+    # this repo are always followed by a wait, and requiring the pair
+    # flow-insensitively would just push people to one-liners)
+    _HANDLED = frozenset({"wait", "communicate", "kill", "terminate"})
+
+    @staticmethod
+    def _is_popen_ctor(call: ast.AST) -> bool:
+        if not isinstance(call, ast.Call):
+            return False
+        q = qualname(call.func)
+        return q is not None and (q == "Popen" or q.endswith(".Popen"))
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, FuncNode):
+                out.extend(self._check_local(ctx, fn))
+        # fire-and-forget at module level or anywhere: a Popen whose
+        # handle is dropped on the floor can never be reaped
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Expr) and self._is_popen_ctor(
+                node.value
+            ):
+                out.append(
+                    self.finding(
+                        ctx, node.value,
+                        "Popen(...) without keeping the handle — nothing "
+                        "can ever wait or terminate this child (orphan "
+                        "by construction)",
+                    )
+                )
+        return out
+
+    def _check_class(self, ctx: ModuleCtx, cls: ast.ClassDef):
+        """Popen handles stored on self must be waited/terminated by
+        SOME method (directly or via a ``p = self.proc; p.wait()``
+        alias) — the owner that holds the child must also be able to
+        end and reap it."""
+        proc_attrs: Dict[str, ast.AST] = {}  # attr -> ctor node
+        handled: Set[str] = set()
+        for m in (n for n in cls.body if isinstance(n, FuncNode)):
+            local_procs: Set[str] = set()
+            attr_alias: Dict[str, str] = {}  # local name -> self attr
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and self._is_popen_ctor(
+                    node.value
+                ):
+                    for tgt in node.targets:
+                        tq = qualname(tgt)
+                        if tq and tq.startswith("self."):
+                            proc_attrs.setdefault(
+                                tq.split(".", 1)[1], node.value
+                            )
+                        elif isinstance(tgt, ast.Name):
+                            local_procs.add(tgt.id)
+                elif isinstance(node, ast.Assign):
+                    vq = qualname(node.value)
+                    for tgt in node.targets:
+                        tq2 = qualname(tgt)
+                        if isinstance(tgt, ast.Name):
+                            if vq and vq.startswith("self."):
+                                attr_alias[tgt.id] = vq.split(".", 1)[1]
+                        elif tq2 and tq2.startswith("self.") and (
+                            isinstance(node.value, ast.Name)
+                            and node.value.id in local_procs
+                        ):
+                            # p = Popen(...); ...; self.proc = p
+                            proc_attrs.setdefault(
+                                tq2.split(".", 1)[1], node.value
+                            )
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._HANDLED
+                ):
+                    rq = qualname(node.func.value)
+                    if rq and rq.startswith("self."):
+                        handled.add(rq.split(".", 1)[1])
+                    elif isinstance(node.func.value, ast.Name):
+                        a = attr_alias.get(node.func.value.id)
+                        if a is not None:
+                            handled.add(a)
+        out = []
+        for attr, ctor in proc_attrs.items():
+            if attr in handled:
+                continue
+            out.append(
+                self.finding(
+                    ctx, ctor,
+                    "%s stores a Popen on self.%s but no method ever "
+                    "waits or terminates it — the child outlives (or "
+                    "zombifies under) its owner; reap the handle on "
+                    "every exit path (wait/communicate, kill as the "
+                    "backstop)" % (cls.name, attr),
+                )
+            )
+        return out
+
+    def _check_local(self, ctx: ModuleCtx, fn) -> List[Finding]:
+        """Function-local Popen handles (not stored on self / a
+        container, not returned, not passed to an owner) must be waited
+        or terminated in the same function."""
+        local: Dict[str, ast.AST] = {}
+        escaped: Set[str] = set()
+        handled: Set[str] = set()
+        for node in walk_no_nested_funcs(fn):
+            if isinstance(node, ast.Assign) and self._is_popen_ctor(
+                node.value
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        local[tgt.id] = node.value
+                    # self.X / container targets are ownership transfers
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name
+                ):
+                    if node.func.attr in self._HANDLED:
+                        handled.add(node.func.value.id)
+                # passed elsewhere (an owner takes it): escapes
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if isinstance(arg, ast.Name):
+                        escaped.add(arg.id)
+            elif isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ):
+                escaped.add(node.value.id)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Name
+            ):
+                for tgt in node.targets:
+                    tq = qualname(tgt)
+                    if (tq and "." in tq) or isinstance(
+                        tgt, ast.Subscript
+                    ):
+                        # self.X = p / obj.attr = p / procs[i] = p
+                        escaped.add(node.value.id)
+        out = []
+        for name, ctor in local.items():
+            if name in handled or name in escaped:
+                continue
+            out.append(
+                self.finding(
+                    ctx, ctor,
+                    "local Popen %r in %r is never waited or terminated "
+                    "in this function and never handed to an owner — "
+                    "the child leaks past every exit path (the orphan-"
+                    "replica shape)" % (name, fn.name),
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------
+# 13-16. concurrency-protocol rules (lint/locks.py: the lock-effect
 # analysis + whole-project held-set propagation they all ride on)
 # ---------------------------------------------------------------------
 
@@ -1890,7 +2066,7 @@ class LockLeak(_LockRule):
 
 
 # ---------------------------------------------------------------------
-# 16. metric-name-drift
+# 17. metric-name-drift
 # ---------------------------------------------------------------------
 
 _METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
@@ -2007,6 +2183,7 @@ RULES = (
     ThreadCollective(),
     AtomicPublish(),
     ThreadJoin(),
+    SubprocessLifecycle(),
     LockOrderInversion(),
     BlockingUnderLock(),
     CondWaitDiscipline(),

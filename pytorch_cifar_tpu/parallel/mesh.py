@@ -157,9 +157,19 @@ def broadcast_pytree(tree, chunk_bytes: int = _BROADCAST_CHUNK_BYTES):
     if not gloo_transport_fragile():
         return multihost_utils.broadcast_one_to_all(tree)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    arrs = [np.ascontiguousarray(leaf) for leaf in leaves]
+    # np.asarray, NOT ascontiguousarray: the latter promotes 0-d leaves
+    # to shape (1,), which silently reshaped every scalar a restore
+    # broadcast carried (TrainState.step came back (1,) on every rank —
+    # latent until the elastic trainer first RESUMED TRAINING from a
+    # multihost save and fold_in rejected the non-scalar step)
+    arrs = [np.asarray(leaf) for leaf in leaves]
     packed = (
-        np.concatenate([a.reshape(-1).view(np.uint8) for a in arrs])
+        np.concatenate(
+            [
+                np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+                for a in arrs
+            ]
+        )
         if arrs
         else np.zeros(0, np.uint8)
     )

@@ -50,6 +50,15 @@ becomes a long-lived prediction service:
   construction runs a distributed warmup barrier so no process serves
   ahead of a straggler, and watchdogs bound dead-peer detection
   (SERVING.md "Multi-process mesh replica").
+- :mod:`~pytorch_cifar_tpu.serve.fleet` is the elastic control plane:
+  a :class:`~pytorch_cifar_tpu.serve.fleet.FleetController` scrapes the
+  fleet's existing ``/healthz`` + ``/metrics`` surfaces, runs a
+  deterministic injectable-clock scaling policy (utilization bands with
+  hysteresis + per-direction cooldowns, min/max bounds), and actuates
+  through the ``router_run`` lifecycle — spawn a warm replica on the
+  shared AOT cache and register it live, or deregister-then-SIGTERM-
+  drain one whose drain costs nothing (``tools/fleet_run.py`` wires
+  controller + router + replicas; SERVING.md "Elastic fleet").
 - :mod:`~pytorch_cifar_tpu.serve.canary` closes the train→serve loop:
   a :class:`~pytorch_cifar_tpu.serve.canary.PromotionController` vets
   every checkpoint a ``--publish staging`` trainer commits — golden-batch
@@ -77,6 +86,11 @@ from pytorch_cifar_tpu.serve.canary import (  # noqa: F401
 from pytorch_cifar_tpu.serve.engine import (  # noqa: F401
     InferenceEngine,
     load_checkpoint_trees,
+)
+from pytorch_cifar_tpu.serve.fleet import (  # noqa: F401
+    FleetController,
+    FleetPolicy,
+    FleetSignals,
 )
 from pytorch_cifar_tpu.serve.frontend import (  # noqa: F401
     BatcherBackend,

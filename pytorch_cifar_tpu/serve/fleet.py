@@ -1,0 +1,766 @@
+"""Elastic fleet controller: autoscaling serving replicas.
+
+"Millions of users" means diurnal load, not a hand-launched fixed
+replica count. This module is the control plane that turns load swings
+and host preemptions from operator incidents into automatic,
+bounded-latency events (SERVING.md "Elastic fleet"; ROADMAP item 3):
+
+- :class:`FleetPolicy` — the deterministic scaling policy: a
+  target-utilization band on per-replica load (queued + in-flight work)
+  with hysteresis (``queue_high`` > ``queue_low``), per-direction
+  sustained-signal windows (``up_after_s`` / ``down_after_s``) and
+  cooldowns, hard ``min_replicas`` / ``max_replicas`` bounds, plus the
+  latency-side triggers: a p99 bound and deadline-expiry deltas. Pure
+  data + arithmetic — no clocks, no I/O — so tests drive it exactly.
+- :class:`FleetSignals` — one scrape of the fleet's EXISTING
+  observability surfaces: the router ``/healthz`` (per-replica queue
+  depth, in-flight counts, health) and the fleet frontend's Prometheus
+  ``/metrics`` (router latency histogram → p99, edge 504s → deadline
+  expiries). The controller invents no new telemetry channel; it reads
+  what operators already scrape.
+- :class:`FleetController` — the loop: scrape → evaluate → actuate.
+  Scale-up spawns a replica through the ``router_run`` lifecycle (a
+  ``serve.py --http_port 0`` process on the shared ``--aot_cache``, so
+  it joins warm with ``compile_count == 0``), waits for ``/healthz`` to
+  go green, and registers it with the live
+  :class:`~pytorch_cifar_tpu.serve.router.Router`
+  (:meth:`~pytorch_cifar_tpu.serve.router.Router.add_replica`).
+  Scale-down happens only when a drain costs nothing (the victim has no
+  router-side in-flight work and an empty queue): the replica is
+  removed from rotation FIRST (no new dispatches), then SIGTERM'd —
+  ``serve.py``'s graceful-drain path answers everything already
+  admitted — and the process is ALWAYS reaped (wait, kill as backstop):
+  the controller can never leave an orphan replica behind (the failure
+  shape graftcheck's ``subprocess-lifecycle`` rule now checks
+  statically). A replica that dies under the controller (preemption,
+  SIGKILL) is reaped, deregistered, and replaced by the ``min_replicas``
+  floor — which bypasses pressure timing (an outage is not a load
+  signal) but still never exceeds ``max_replicas``.
+
+The clock is injectable (``clock=``), every decision is taken in
+``control_once()`` (the background thread just calls it on an
+interval), and the actuator is a plain callable — so the whole policy
+state machine is unit-testable with zero subprocesses and zero sleeps
+(tests/test_fleet.py).
+
+Telemetry (OBSERVABILITY.md "elastic fleet"): ``serve.fleet.replicas``
+(gauge), ``serve.fleet.pressure`` (gauge: the per-replica load the band
+compares against), ``serve.fleet.scale_ups`` / ``serve.fleet.scale_downs``
+/ ``serve.fleet.replica_failures`` / ``serve.fleet.scrape_errors``
+(counters), ``serve.fleet.spawn_ms`` / ``serve.fleet.drain_ms``
+(histograms).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from pytorch_cifar_tpu.obs import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+# the ready line serve.py prints; the pump thread parses the replica URL
+# from it (same contract tools/router_run.py consumes)
+_URL_RE = re.compile(r"==> http: serving on (http://\S+)")
+
+
+# ---------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FleetPolicy:
+    """Deterministic scaling policy (module docstring).
+
+    ``queue_high``/``queue_low`` bound the per-replica load — queued
+    images (both priority lanes) plus router-side in-flight requests,
+    divided by the healthy replica count. The band IS the hysteresis:
+    load between the two thresholds holds the fleet steady, and the
+    sustained-signal windows + cooldowns keep a bursty minute from
+    flapping replicas up and down."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # target-utilization band on per-replica load
+    queue_high: float = 8.0
+    queue_low: float = 1.0
+    # latency-side scale-up triggers: 0 disables the p99 trigger;
+    # deadline expiries always count (an expiry is never acceptable)
+    p99_high_ms: float = 0.0
+    # sustained-signal windows: pressure/idle must hold this long
+    up_after_s: float = 2.0
+    down_after_s: float = 10.0
+    # per-direction cooldowns since the LAST action in that direction
+    up_cooldown_s: float = 5.0
+    down_cooldown_s: float = 20.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.queue_low > self.queue_high:
+            raise ValueError(
+                "queue_low must be <= queue_high (the hysteresis band)"
+            )
+
+
+@dataclasses.dataclass
+class FleetSignals:
+    """One observation of the fleet, scraped from the existing
+    ``/healthz`` + ``/metrics`` surfaces (or constructed directly by
+    tests). ``deadline_expired`` is CUMULATIVE (the edge's 504 counter);
+    the controller differences consecutive scrapes."""
+
+    healthy: int = 0
+    queued: int = 0          # summed per-replica queue depth, both lanes
+    bulk_queued: int = 0     # the bulk-lane share of `queued`
+    in_flight: int = 0       # router-side dispatched-not-yet-answered
+    deadline_expired: float = 0.0  # cumulative fleet-edge 504s
+    p99_ms: float = 0.0      # router-observed request latency p99
+
+    @property
+    def load_per_replica(self) -> float:
+        """The number the utilization band compares against."""
+        return (self.queued + self.in_flight) / max(self.healthy, 1)
+
+    @staticmethod
+    def from_http(health: dict, prom_text: str = "") -> "FleetSignals":
+        """Build signals from a router ``/healthz`` payload plus the
+        fleet frontend's Prometheus ``/metrics`` text. Tolerant of
+        partial payloads (a replica mid-join may not report queue stats
+        yet): missing fields read as zero, never raise."""
+        queued = bulk = in_flight = 0
+        for rep in health.get("replicas", ()):
+            in_flight += int(rep.get("in_flight") or 0)
+            q = (rep.get("health") or {}).get("queued")
+            if isinstance(q, dict):
+                queued += sum(int(v or 0) for v in q.values())
+                bulk += int(q.get("bulk") or 0)
+            elif q:
+                queued += int(q)
+        return FleetSignals(
+            healthy=int(health.get("healthy_replicas") or 0),
+            queued=queued,
+            bulk_queued=bulk,
+            in_flight=in_flight,
+            deadline_expired=parse_prom_counter(
+                prom_text, "pct_serve_http_504"
+            ),
+            p99_ms=parse_prom_histogram_percentile(
+                prom_text, "pct_router_latency_ms", 99.0
+            ),
+        )
+
+
+def parse_prom_counter(text: str, name: str) -> float:
+    """Value of counter ``name`` in Prometheus exposition text (0.0 when
+    absent — a counter nobody incremented is never exported)."""
+    m = re.search(
+        r"^%s ([0-9.eE+-]+)$" % re.escape(name), text, re.MULTILINE
+    )
+    return float(m.group(1)) if m else 0.0
+
+
+def parse_prom_histogram_percentile(
+    text: str, name: str, pct: float
+) -> float:
+    """Percentile estimate from a Prometheus cumulative-bucket series:
+    the upper bound of the first bucket whose cumulative count reaches
+    the rank (the standard coarse estimate; the controller only
+    thresholds it). 0.0 when the histogram is absent or empty."""
+    buckets: List[tuple] = []  # (bound, cumulative_count)
+    for m in re.finditer(
+        r'^%s_bucket\{le="([^"]+)"\} ([0-9.eE+-]+)$' % re.escape(name),
+        text,
+        re.MULTILINE,
+    ):
+        bound = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+        buckets.append((bound, float(m.group(2))))
+    if not buckets:
+        return 0.0
+    buckets.sort(key=lambda b: b[0])
+    total = buckets[-1][1]
+    if total <= 0:
+        return 0.0
+    rank = pct / 100.0 * total
+    last_finite = 0.0
+    for bound, cum in buckets:
+        if bound != float("inf"):
+            last_finite = bound
+        if cum >= rank:
+            return bound if bound != float("inf") else last_finite
+    return last_finite
+
+
+def scrape_fleet(url: str, timeout_s: float = 5.0) -> FleetSignals:
+    """The default scrape: GET ``/healthz`` + ``/metrics`` on the fleet
+    frontend (the router's own health payload embeds every replica's
+    last probed health, so one endpoint shows the whole fleet). Raises
+    OSError/ValueError on an unreachable or unparseable fleet — the
+    controller counts the miss and holds."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    def get(path):
+        try:
+            with urllib.request.urlopen(url + path, timeout=timeout_s) as r:
+                return r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            # /healthz answers 503 when unhealthy — the body is still
+            # the health payload and the controller wants to read it
+            return e.read().decode("utf-8")
+
+    health = json.loads(get("/healthz"))
+    prom = get("/metrics")
+    return FleetSignals.from_http(health, prom)
+
+
+class ScalingEvaluator:
+    """The deterministic decision state machine: feed it one
+    (signals, replica count, now) observation per sweep and it answers
+    ``("up"|"down"|"hold", reason)``. Pure arithmetic over the policy —
+    no clocks (``now`` is an argument), no I/O, no threads: the
+    controller owns the single thread that drives it, so every field
+    here is single-writer by construction, and tests can replay any
+    pressure history exactly.
+
+    The controller reports back with :meth:`acted_up` /
+    :meth:`acted_down` after a SUCCESSFUL actuation — cooldowns stamp
+    from completed actions, not attempts (a failed spawn must not eat
+    the cooldown and delay the retry)."""
+
+    def __init__(self, policy: FleetPolicy):
+        self.policy = policy
+        self.pressure_since: Optional[float] = None
+        self.idle_since: Optional[float] = None
+        self.last_up: Optional[float] = None
+        self.last_down: Optional[float] = None
+        self.last_expired = 0.0
+        self.last_signals: Optional[FleetSignals] = None
+
+    def evaluate(self, signals: FleetSignals, n: int, now: float):
+        """One sweep's verdict. ``n`` is the managed replica count (the
+        authoritative one — the scraped ``healthy`` can lag a join)."""
+        p = self.policy
+        self.last_signals = signals
+        # min-replicas floor first: a dead replica is replaced
+        # immediately — an outage is not a load signal, so neither the
+        # pressure window nor the up-cooldown applies
+        if n < p.min_replicas:
+            return "up", "min-replicas floor"
+        expired_delta = max(
+            0.0, signals.deadline_expired - self.last_expired
+        )
+        self.last_expired = signals.deadline_expired
+        load = signals.load_per_replica
+        p99_bad = p.p99_high_ms > 0 and signals.p99_ms > p.p99_high_ms
+        up_pressure = load > p.queue_high or expired_delta > 0 or p99_bad
+        idle = (
+            load < p.queue_low and expired_delta == 0 and not p99_bad
+        )
+
+        if up_pressure:
+            self.idle_since = None
+            if self.pressure_since is None:
+                self.pressure_since = now
+            sustained = now - self.pressure_since >= p.up_after_s
+            cooled = (
+                self.last_up is None
+                or now - self.last_up >= p.up_cooldown_s
+            )
+            if sustained and cooled and n < p.max_replicas:
+                if load > p.queue_high:
+                    reason = f"load {load:.1f} > {p.queue_high:.1f}"
+                elif expired_delta > 0:
+                    reason = f"{expired_delta:.0f} deadline expiries"
+                else:
+                    reason = f"p99 {signals.p99_ms:.0f}ms"
+                return "up", reason
+            return "hold", "pressure building"
+
+        self.pressure_since = None
+        if not idle:
+            # inside the hysteresis band: steady state, windows reset
+            self.idle_since = None
+            return "hold", "in band"
+        if self.idle_since is None:
+            self.idle_since = now
+        sustained = now - self.idle_since >= p.down_after_s
+        cooled = (
+            self.last_down is None
+            or now - self.last_down >= p.down_cooldown_s
+        )
+        if sustained and cooled and n > p.min_replicas:
+            return "down", f"load {load:.1f} < {p.queue_low:.1f}"
+        return "hold", "idle building"
+
+    def acted_up(self, now: float) -> None:
+        self.last_up = now
+        self.pressure_since = None
+
+    def acted_down(self, now: float) -> None:
+        self.last_down = now
+        self.idle_since = None
+
+
+# ---------------------------------------------------------------------
+# replica process lifecycle (the router_run actuation path)
+# ---------------------------------------------------------------------
+
+
+def repo_root() -> str:
+    """The checkout root (the directory holding serve.py)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+class ReplicaProcess:
+    """One spawned ``serve.py --http_port 0`` replica: the subprocess, a
+    stderr pump thread (forwards lines with a ``[replica i]`` prefix and
+    captures the frontend URL from the ready line), and the drain-aware
+    decommission path. Same process contract as
+    ``tools/router_run.py``'s launcher — SIGTERM is the graceful-drain
+    signal, and the handle is ALWAYS reaped (wait, kill backstop)."""
+
+    def __init__(self, idx, cmd: List[str], env: Optional[dict] = None,
+                 cwd: Optional[str] = None):
+        self.idx = idx
+        self.cmd = list(cmd)
+        self.proc = subprocess.Popen(
+            self.cmd,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=cwd or repo_root(),
+        )
+        self.health: dict = {}
+        # _url is written by the pump thread, read by wait_url callers
+        self._lock = threading.Lock()
+        self._url: Optional[str] = None
+        self._url_ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, name=f"fleet-replica-stderr-{idx}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    @property
+    def url(self) -> Optional[str]:
+        with self._lock:
+            return self._url
+
+    def _pump(self) -> None:
+        for line in self.proc.stderr:
+            m = _URL_RE.search(line)
+            if m:
+                with self._lock:
+                    self._url = m.group(1)
+                self._url_ready.set()
+            sys.stderr.write(f"[replica {self.idx}] {line}")
+        self._url_ready.set()  # EOF unblocks a waiter even on crash
+
+    def wait_url(self, timeout_s: float) -> Optional[str]:
+        self._url_ready.wait(timeout_s)
+        return self.url
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def wait_healthy(self, timeout_s: float) -> dict:
+        """Block until ``/healthz`` answers 200; returns (and stores)
+        the health payload — the compile counts ride it, which is the
+        warm-start evidence the drills pin. Raises RuntimeError when the
+        replica dies or never turns healthy (the caller reaps it)."""
+        from pytorch_cifar_tpu.serve.router import Replica, ReplicaError
+
+        url = self.wait_url(timeout_s)
+        if url is None or not self.alive():
+            raise RuntimeError(
+                f"replica {self.idx} exited rc={self.proc.returncode} "
+                "before its frontend came up"
+            )
+        client = Replica(url, timeout_s=5.0)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while time.monotonic() < deadline:
+                if not self.alive():
+                    raise RuntimeError(
+                        f"replica {self.idx} died during warmup "
+                        f"(rc={self.proc.returncode})"
+                    )
+                try:
+                    status, health = client.request("GET", "/healthz")
+                except ReplicaError:
+                    time.sleep(0.1)
+                    continue
+                if status == 200:
+                    self.health = health
+                    return health
+                time.sleep(0.1)
+        finally:
+            client.close()
+        raise RuntimeError(f"replica {self.idx} never became healthy")
+
+    def decommission(self, timeout_s: float = 60.0) -> float:
+        """SIGTERM (the drain signal), wait, SIGKILL backstop, drain the
+        pipes, join the pump thread. Returns the drain wall seconds.
+        Idempotent and safe on an already-dead process — the corpse is
+        still reaped, never orphaned."""
+        t0 = time.monotonic()
+        if self.alive():
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log.warning(
+                "replica %s ignored SIGTERM for %.0fs; killing",
+                self.idx, timeout_s,
+            )
+            self.proc.kill()
+            self.proc.wait()
+        if self.proc.stdout is not None:
+            self.proc.stdout.read()
+        self._thread.join(timeout=10)
+        return time.monotonic() - t0
+
+
+def make_replica_launcher(
+    ckpt: str,
+    model: str,
+    *,
+    aot_cache: str,
+    buckets=(1, 8, 32),
+    deadline_ms: float = 0.0,
+    max_wait_ms: float = 2.0,
+    num_devices: int = 1,
+    host: str = "127.0.0.1",
+    extra_args=(),
+    env: Optional[dict] = None,
+    timeout_s: float = 300.0,
+) -> Callable[[int], ReplicaProcess]:
+    """Build the controller's spawn callable: launch one ``serve.py``
+    replica on the shared AOT cache and block until healthy. The first
+    replica of a fleet populates the cache; every replica this launcher
+    spawns afterwards imports the executables and joins with
+    ``compile_count == 0`` — exactly what makes scale-out cheap enough
+    to automate (SERVING.md "AOT executable cache")."""
+    base_env = dict(os.environ if env is None else env)
+    base_env.setdefault("JAX_PLATFORMS", "cpu")
+
+    def launch(idx: int) -> ReplicaProcess:
+        cmd = [
+            sys.executable, os.path.join(repo_root(), "serve.py"),
+            "--ckpt", ckpt,
+            "--model", model,
+            "--http_port", "0",
+            "--http_host", host,
+            "--buckets", *[str(b) for b in buckets],
+            "--max_wait_ms", str(max_wait_ms),
+            "--deadline_ms", str(deadline_ms),
+            "--num_devices", str(num_devices),
+            "--aot_cache", aot_cache,
+            *extra_args,
+        ]
+        replica = ReplicaProcess(idx, cmd, env=base_env)
+        try:
+            replica.wait_healthy(timeout_s)
+        except RuntimeError:
+            replica.decommission(timeout_s=10.0)
+            raise
+        return replica
+
+    return launch
+
+
+# ---------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------
+
+
+class FleetController:
+    """Scrape → evaluate → actuate (module docstring).
+
+    ``launcher(idx) -> handle`` spawns one replica and returns a handle
+    with ``url``/``health``/``alive()``/``decommission()`` (a
+    :class:`ReplicaProcess`, or a test fake). ``scrape() ->
+    FleetSignals`` reads the fleet (default: :func:`scrape_fleet` on the
+    fleet frontend URL). All policy state advances only inside
+    :meth:`control_once`, stamped by the injectable ``clock`` — the
+    background thread (``start()``/``stop()``) just calls it every
+    ``interval_s``."""
+
+    def __init__(
+        self,
+        router,
+        launcher: Callable[[int], object],
+        policy: FleetPolicy,
+        *,
+        scrape: Callable[[], FleetSignals],
+        registry: Optional[MetricsRegistry] = None,
+        interval_s: float = 0.5,
+        clock: Callable[[], float] = time.monotonic,
+        drain_timeout_s: float = 60.0,
+    ):
+        self.router = router
+        self.launcher = launcher
+        self.policy = policy
+        self.scrape = scrape
+        self.interval_s = float(interval_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._clock = clock
+        self.obs = registry if registry is not None else MetricsRegistry()
+        self._g_replicas = self.obs.gauge("serve.fleet.replicas")
+        self._g_pressure = self.obs.gauge("serve.fleet.pressure")
+        self._c_ups = self.obs.counter("serve.fleet.scale_ups")
+        self._c_downs = self.obs.counter("serve.fleet.scale_downs")
+        self._c_failures = self.obs.counter("serve.fleet.replica_failures")
+        self._c_scrape_errors = self.obs.counter("serve.fleet.scrape_errors")
+        self._h_spawn = self.obs.histogram("serve.fleet.spawn_ms")
+        self._h_drain = self.obs.histogram("serve.fleet.drain_ms")
+        # managed replicas: url -> handle. Guarded by _lock (the control
+        # thread and adopt()/stop() callers both touch it); every
+        # blocking operation (scrape, spawn, drain) runs OUTSIDE it.
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, object] = {}
+        self._next_idx = 0
+        # the decision state machine: driven ONLY by control_once (one
+        # thread), so its fields need no lock — see ScalingEvaluator
+        self.evaluator = ScalingEvaluator(policy)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def last_signals(self) -> Optional[FleetSignals]:
+        return self.evaluator.last_signals
+
+    # -- membership ----------------------------------------------------
+
+    def adopt(self, handle) -> None:
+        """Take lifecycle ownership of an already-spawned replica (the
+        launcher's seed fleet): the controller will reap it on failure
+        and may drain it on scale-down. The replica must already be in
+        the router's rotation."""
+        with self._lock:
+            self._replicas[handle.url] = handle
+            self._next_idx = max(self._next_idx, int(handle.idx) + 1)
+        self._g_replicas.set(len(self.replicas()))
+
+    def replicas(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._replicas)
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "replicas": len(self.replicas()),
+            "scale_ups": int(self._c_ups.value),
+            "scale_downs": int(self._c_downs.value),
+            "replica_failures": int(self._c_failures.value),
+            "scrape_errors": int(self._c_scrape_errors.value),
+        }
+
+    # -- actuation -----------------------------------------------------
+
+    def _spawn_one(self, reason: str) -> bool:
+        """Launch + register one replica. Returns success. Spawn runs
+        outside the lock (it blocks for the replica's cold start — load
+        time from the warm AOT cache, compile time on a cold one)."""
+        with self._lock:
+            idx = self._next_idx
+            self._next_idx += 1
+        t0 = self._clock()
+        try:
+            handle = self.launcher(idx)
+        except Exception as e:
+            log.warning("scale-up spawn failed (%s): %s", reason, e)
+            self._c_failures.inc()
+            return False
+        self._h_spawn.observe((self._clock() - t0) * 1e3)
+        self.router.add_replica(handle.url)
+        with self._lock:
+            self._replicas[handle.url] = handle
+            n = len(self._replicas)
+        self._c_ups.inc()
+        self._g_replicas.set(n)
+        compiles = (getattr(handle, "health", None) or {}).get("compiles")
+        log.info(
+            "fleet scale-up (%s): replica %s url=%s compiles=%s -> %d "
+            "replicas", reason, idx, handle.url, compiles, n,
+        )
+        print(
+            f"==> fleet: scale-up replica {idx} url={handle.url} "
+            f"pid={getattr(handle, 'pid', '?')} compiles={compiles} "
+            f"({reason})",
+            file=sys.stderr,
+        )
+        return True
+
+    def _drain_one(self, handle, count: bool = True) -> None:
+        """Deregister-then-drain one replica (never the reverse order:
+        a request dispatched after the SIGTERM would race the drain).
+        ``count=False`` for the shutdown path — tearing the whole fleet
+        down is not a scale event."""
+        self.router.remove_replica(handle.url)
+        with self._lock:
+            self._replicas.pop(handle.url, None)
+            n = len(self._replicas)
+        drain_s = handle.decommission(self.drain_timeout_s)
+        self._h_drain.observe(drain_s * 1e3)
+        if count:
+            self._c_downs.inc()
+        self._g_replicas.set(n)
+        log.info(
+            "fleet scale-down: drained %s in %.2fs -> %d replicas",
+            handle.url, drain_s, n,
+        )
+        print(
+            f"==> fleet: scale-down replica {handle.idx} "
+            f"url={handle.url} drain_s={drain_s:.2f}",
+            file=sys.stderr,
+        )
+
+    def _reap_dead(self) -> int:
+        """Remove replicas whose process died under us (preemption,
+        SIGKILL): deregister from the router, reap the corpse (a dead
+        child still needs its wait()), count the failure. Returns how
+        many were reaped."""
+        with self._lock:
+            dead = [
+                h for h in self._replicas.values() if not h.alive()
+            ]
+        for handle in dead:
+            self.router.remove_replica(handle.url)
+            with self._lock:
+                self._replicas.pop(handle.url, None)
+            handle.decommission(timeout_s=5.0)  # reap, never orphan
+            self._c_failures.inc()
+            log.warning(
+                "replica %s died; removed from rotation", handle.url
+            )
+            print(
+                f"==> fleet: replica {handle.idx} died; removed "
+                f"url={handle.url}",
+                file=sys.stderr,
+            )
+        if dead:
+            self._g_replicas.set(len(self.replicas()))
+        return len(dead)
+
+    # -- the decision --------------------------------------------------
+
+    def control_once(self, now: Optional[float] = None) -> str:
+        """One control sweep: reap, scrape, evaluate, actuate. Returns
+        the action taken — ``"up"``, ``"down"``, ``"replace"``
+        (min-floor refill after a replica failure), or ``"hold"``.
+        Deterministic given (signals, clock): the evaluator's state
+        advances here and nowhere else."""
+        now = self._clock() if now is None else now
+        self._reap_dead()
+        try:
+            signals = self.scrape()
+        except (OSError, ValueError) as e:
+            self._c_scrape_errors.inc()
+            log.warning("fleet scrape failed: %s", e)
+            return "hold"
+        self._g_pressure.set(signals.load_per_replica)
+        n = len(self.replicas())
+        action, reason = self.evaluator.evaluate(signals, n, now)
+        if action == "up" and n < self.policy.max_replicas:
+            if self._spawn_one(reason):
+                self.evaluator.acted_up(now)
+                return (
+                    "replace" if reason == "min-replicas floor" else "up"
+                )
+            return "hold"
+        if action == "down":
+            victim = self._pick_drain_victim()
+            if victim is None:
+                return "hold"  # nobody drains for free right now
+            self._drain_one(victim)
+            self.evaluator.acted_down(now)
+            return "down"
+        return "hold"
+
+    def _pick_drain_victim(self):
+        """The managed replica whose drain costs nothing: zero
+        router-side in-flight requests AND an empty probed queue. Ties
+        break toward the HIGHEST index (newest first — the oldest
+        replica keeps the longest-lived caches). None when every replica
+        still holds work (scale-down never kills in-flight requests)."""
+        managed = self.replicas()
+        router_view = self.router.fleet_view()
+        candidates = []
+        for url, handle in managed.items():
+            in_flight, last_health = router_view.get(url, (0, {}))
+            q = (last_health or {}).get("queued")
+            queued = (
+                sum(int(v or 0) for v in q.values())
+                if isinstance(q, dict)
+                else int(q or 0)
+            )
+            if in_flight == 0 and queued == 0:
+                candidates.append((int(handle.idx), handle))
+        if not candidates:
+            return None
+        return max(candidates, key=lambda c: c[0])[1]
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.control_once()
+            except Exception:
+                log.exception("fleet control sweep failed")
+
+    def start(self) -> "FleetController":
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="fleet-controller", daemon=True
+                )
+                self._thread.start()
+        return self
+
+    def stop(self, drain_replicas: bool = False) -> None:
+        """Stop the control loop (joined outside the lock). With
+        ``drain_replicas`` every managed replica is deregistered and
+        drained too — the fleet launcher's shutdown path."""
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join()
+        if drain_replicas:
+            for handle in list(self.replicas().values()):
+                self._drain_one(handle, count=False)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -248,6 +248,65 @@ class Router:
         self._shadow_model = None  # tee only this model's traffic
         self._g_healthy.set(len(self.replicas))
 
+    # -- fleet membership (serve/fleet.py; SERVING.md "Elastic fleet") --
+
+    def add_replica(self, url: str) -> "Replica":
+        """Register a replica with the live router — the fleet
+        controller's scale-up hook. The new replica enters rotation
+        healthy (the caller has already waited for its /healthz to go
+        green; the probe thread would evict it within ``fail_after``
+        sweeps if that trust was misplaced). Re-adding a URL already in
+        rotation returns the existing entry (idempotent: a controller
+        retry must not double-register)."""
+        replica = Replica(url, timeout_s=self.request_timeout_s)
+        with self._lock:
+            for r in self.replicas:
+                if r.url == replica.url:
+                    return r
+            self.replicas.append(replica)
+            healthy = sum(r.healthy for r in self.replicas)
+        self._g_healthy.set(healthy)
+        log.info("added replica %s (fleet size %d)", replica.url, healthy)
+        return replica
+
+    def remove_replica(self, url: str) -> Optional["Replica"]:
+        """Deregister a replica — the fleet controller's scale-down
+        hook, called BEFORE the process is drained so no new request is
+        ever dispatched to a replica that is about to stop. Requests
+        already in flight on other threads hold their own reference and
+        complete normally (the SIGTERM drain on the replica side answers
+        them). Returns the removed Replica (its ``in_flight`` lets the
+        caller wait out the router-side tail), or None when the URL is
+        not in rotation."""
+        canonical = Replica(url).url
+        with self._lock:
+            found = None
+            for r in self.replicas:
+                if r.url == canonical:
+                    found = r
+                    break
+            if found is not None:
+                self.replicas.remove(found)
+            healthy = sum(r.healthy for r in self.replicas)
+        if found is not None:
+            self._g_healthy.set(healthy)
+            log.info(
+                "removed replica %s from rotation (fleet size %d)",
+                found.url, healthy,
+            )
+        return found
+
+    def fleet_view(self) -> dict:
+        """One consistent snapshot of dispatch state per replica —
+        ``{url: (in_flight, last_probed_health)}`` — for the fleet
+        controller's drain-victim choice (a replica with in-flight work
+        or a non-empty probed queue never drains)."""
+        with self._lock:
+            return {
+                r.url: (r.in_flight, dict(r.last_health))
+                for r in self.replicas
+            }
+
     def attach_shadow(self, controller) -> None:
         """Tee answered requests to a canary
         :class:`~pytorch_cifar_tpu.serve.canary.PromotionController`:
@@ -497,8 +556,12 @@ class Router:
     def probe_once(self) -> int:
         """One probe sweep (the probe thread's body; tests drive it
         directly for timing-free determinism). Returns the healthy
-        count."""
-        for replica in self.replicas:
+        count. Probes a snapshot of the membership: the fleet controller
+        may add/remove replicas concurrently (a removed replica simply
+        stops being probed from the next sweep)."""
+        with self._lock:
+            replicas = list(self.replicas)
+        for replica in replicas:
             try:
                 status, health = replica.request(
                     "GET", "/healthz", timeout_s=self.probe_timeout_s

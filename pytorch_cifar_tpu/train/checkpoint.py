@@ -24,6 +24,12 @@ Formats (ROBUSTNESS.md):
   state is replicated, so every host already holds the full serialized
   bytes, the reassembled payload is bit-identical to a v2 save of the
   same state, and restore reuses the exact v2 deserialization path.
+  A consequence the elastic-training path (ROADMAP item 3) leans on:
+  restore accepts a v3 save written by M processes into a world of N
+  for ANY M, N — process 0 reassembles the committed shard set and
+  broadcasts, so a preempted or added host is a resume, not a restart —
+  and :func:`reshard_checkpoint` re-cuts a committed publish to the new
+  topology with the payload bit-identical.
 
 Saves can be **asynchronous**: ``save_checkpoint(..., writer=...)`` does
 only the device_get snapshot on the calling thread and hands
@@ -823,6 +829,125 @@ def save_checkpoint(
     return os.path.join(output_dir, name) if pidx == 0 else None
 
 
+def committed_shard_count(output_dir: str, name: str) -> Optional[int]:
+    """Shard count of the CURRENT committed publish of ``name``: the
+    length of the commit marker's shard list for a v3 publish, 1 for a
+    monolithic v1/v2 publish, None when no committed publish exists."""
+    meta = _read_meta(output_dir, name)
+    if not meta:
+        return None
+    shards = meta.get("shards")
+    if shards:
+        return len(shards)
+    if os.path.isfile(os.path.join(output_dir, name)):
+        return 1
+    return None
+
+
+def reshard_checkpoint(
+    output_dir: str,
+    name: str = CKPT_NAME,
+    num_shards: int = 1,
+    registry=None,
+) -> str:
+    """Re-cut a committed publish of ``name`` to ``num_shards``
+    byte-range shards — the elastic-training topology change
+    (ROADMAP item 3): a v3 save written by M processes becomes a save
+    laid out for an N-process world, with the PAYLOAD BIT-IDENTICAL
+    (byte-range sharding is a pure layout property; the reassembled
+    bytes never change, which the reshard tests pin).
+
+    Crash-safe by the same commit-marker-last discipline every writer
+    here follows: the new layout's files land first and the sidecar
+    (which atomically REPLACES the old one) describes only complete
+    sets — a crash at any point leaves a restorable checkpoint. The
+    superseded layout's files are removed only after the new commit
+    marker is durable. ``num_shards <= 1`` produces a v2 monolithic
+    publish. Raises FileNotFoundError when no committed publish of
+    ``name`` exists, CheckpointCorrupt when it exists but fails
+    verification (nothing is rewritten from unverified bytes).
+    """
+    meta = _read_meta(output_dir, name)
+    old_n = committed_shard_count(output_dir, name)
+    if old_n is None:
+        raise FileNotFoundError(
+            f"no committed publish of {name!r} in {output_dir!r}"
+        )
+    n = max(int(num_shards), 1)
+    payload = read_verified_payload(output_dir, name, meta)
+    if old_n == n:
+        return os.path.join(output_dir, name)
+    epoch = int(meta.get("epoch", -1))
+    best_acc = float(meta.get("best_acc", 0.0))
+    old_shards = [s["name"] for s in (meta.get("shards") or ())]
+    with trace.span(
+        "checkpoint/reshard", file=name, shards_from=old_n, shards_to=n
+    ):
+        if n > 1:
+            _write_sharded(
+                output_dir, name, payload, epoch, best_acc,
+                keep_last_n=0, num_shards=n, shard_index=None,
+            )
+        else:
+            _write_unsharded(
+                output_dir, name, payload, epoch, best_acc, keep_last_n=0
+            )
+    # the new commit marker is durable; retire the superseded layout.
+    # v3 -> smaller/larger N: the old -of-M names can never collide with
+    # -of-N ones (the span is part of the identity), so this is cleanup,
+    # not correctness. v2 -> v3: the monolithic payload file goes too
+    # (the new sidecar lists shards; a reader never opens it again).
+    stale = [s for s in old_shards]
+    if old_n == 1 and n > 1:
+        stale.append(name)
+    for sn in stale:
+        for p in (
+            os.path.join(output_dir, sn),
+            meta_path(output_dir, sn) if sn != name else None,
+        ):
+            if p is None:
+                continue
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    if registry is not None:
+        registry.counter("checkpoint.reshards").inc()
+    log.info(
+        "resharded %s/%s: %d -> %d shard(s), payload bit-identical",
+        output_dir, name, old_n, n,
+    )
+    return os.path.join(output_dir, name)
+
+
+def reshard_to_world(output_dir: str, registry=None) -> None:
+    """Re-cut every committed checkpoint the resume path may read
+    (best + preemption save) to THIS world's topology — one shard per
+    process under multihost, the monolithic v2 layout single-host.
+    Called by the trainer's elastic resume (process 0 only): after a
+    membership change, restore already accepted the old topology's
+    layout (any M into any N — process 0 reassembles and broadcasts);
+    this step re-cuts the on-disk layout so the new world's own
+    incremental saves and inspectors see one consistent topology."""
+    if jax.process_index() != 0:
+        return
+    world = jax.process_count()
+    n = world if world > 1 else 1
+    for name in (CKPT_NAME, LAST_NAME):
+        old = committed_shard_count(output_dir, name)
+        if old is None or old == n:
+            continue
+        try:
+            reshard_checkpoint(output_dir, name, n, registry=registry)
+        except CheckpointCorrupt as e:
+            # a corrupt candidate is restore's business (it falls back);
+            # resharding must not turn a resumable dir into a crash
+            log.warning(
+                "elastic reshard skipped corrupt candidate %s (%s)",
+                name, e,
+            )
+
+
 def newest_checkpoint_order(output_dir: str):
     """Checkpoint preference for training resume: whichever of
     last.msgpack / ckpt.msgpack has the newer epoch in its meta sidecar
@@ -949,6 +1074,13 @@ def restore_checkpoint(
     never a crash deep inside flax. A v3 publish without its commit
     marker is treated as absent (never reassembled from loose shards).
     Raises FileNotFoundError only when NO candidate is usable.
+
+    Topology-free by construction: a v3 candidate saved by M processes
+    restores into a world of N for any M, N — process 0 reads the
+    commit marker's complete shard set (the saving topology's) and the
+    broadcast hands every current process the same bytes. The elastic
+    trainer additionally re-cuts the on-disk layout to the new world
+    afterwards (:func:`reshard_to_world`).
 
     Returns (state, start_epoch, best_acc); start_epoch is the next epoch
     to run (saved epoch + 1).

@@ -1,0 +1,356 @@
+"""Preemption-elastic training: ``fit()`` survives world-size changes.
+
+ROADMAP item 3's training half. A fixed-world multi-process job dies
+with its first preempted host; an elastic one treats membership change
+as a checkpoint-restore-reshard cycle (SERVING.md documents the serving
+half; this module is the trainer's):
+
+- Every rank trains normally (``train.py --distributed --elastic``),
+  publishing durable checkpoints exactly as before — format v3's
+  per-process byte-range shards, commit marker last.
+- A **membership change** — a rank killed by preemption, or a new host
+  granted — ends the current *generation*: the supervisor
+  (:class:`ElasticTrainRunner`) terminates the surviving ranks (SIGTERM
+  first, which is ``fit()``'s graceful-stop + preemption-save path;
+  SIGKILL bounds a rank wedged in a dead collective), reaps every
+  child, and relaunches the world at the new size with ``--resume``.
+- The relaunch **resumes, never restarts**: restore accepts the old
+  topology's v3 layout into the new world for any M → N (process 0
+  reassembles the committed shard set and broadcasts), the elastic
+  trainer re-cuts the on-disk layout to the new topology
+  (:func:`~pytorch_cifar_tpu.train.checkpoint.reshard_to_world` —
+  payload bit-identical, pinned by the reshard tests), and the data
+  pipeline re-derives its per-process slices from the new mesh by
+  construction (``pipeline.local_slab`` reads the sharding, not a
+  cached world size). Training continues from the last durable epoch.
+
+Rank-side contract: a rank that crashes mid-``fit()`` in a
+multi-process world exits :data:`ELASTIC_RC` (75, EX_TEMPFAIL) — "my
+world broke, resume me" — rather than surfacing a dead-peer collective
+error as an unhandled crash. The supervisor treats any abnormal rank
+exit as a membership event either way; the code just makes the
+post-mortem readable. Restart cycles are bounded by ``max_restarts``:
+an actually-broken run (a crash the resume replays deterministically)
+fails loudly instead of looping forever.
+
+The supervisor is a plain single-machine process tree here (each rank a
+``train.py`` subprocess on a localhost coordinator — the same shape the
+multihost test suite drives); on a real cluster the identical loop runs
+per-allocation with ranks on different hosts. Every child is waited or
+killed on every exit path — the orphan-trainer shape is the same class
+graftcheck's ``subprocess-lifecycle`` rule now rejects statically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+# "membership changed underneath me — relaunch the world and resume"
+# (EX_TEMPFAIL: the sysexits code for try-again-later, which is exactly
+# the contract; serve's mesh watchdog owns 70 for the serving side)
+ELASTIC_RC = 75
+
+# flags the supervisor owns per generation; stripped from the base argv
+# so a relaunch can re-derive them for the new world
+_OWNED_FLAGS = (
+    "--elastic_procs", "--dist_coord", "--dist_procs", "--dist_rank",
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def strip_owned_flags(argv: List[str]) -> List[str]:
+    """Remove supervisor-owned flags (and their values) plus bare
+    ``--distributed``/``--resume`` from a train.py argv: the runner
+    re-adds all of them per generation with the current world's
+    values."""
+    out = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in _OWNED_FLAGS:
+            skip = True
+            continue
+        if any(a.startswith(f + "=") for f in _OWNED_FLAGS):
+            continue
+        if a in ("--distributed", "--no-distributed", "--resume",
+                 "--no-resume", "--elastic", "--no-elastic"):
+            continue
+        out.append(a)
+    return out
+
+
+class _Rank:
+    """One rank subprocess of the current generation: the process plus
+    a stderr pump thread (forwards lines with a ``[rank i]`` prefix).
+    Always reaped via :meth:`reap` — never orphaned."""
+
+    def __init__(self, rank: int, cmd: List[str], env: dict, cwd: str):
+        self.rank = rank
+        self.proc = subprocess.Popen(
+            cmd,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=cwd,
+        )
+        self.stdout_tail: List[str] = []
+        self._thread = threading.Thread(
+            target=self._pump, name=f"elastic-rank-stderr-{rank}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _pump(self) -> None:
+        for line in self.proc.stderr:
+            sys.stderr.write(f"[rank {self.rank}] {line}")
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def reap(self, timeout_s: float) -> int:
+        """Wait the child out (SIGKILL backstop — a rank wedged in a
+        dead gloo collective never answers SIGTERM), drain its stdout
+        (the ``best test accuracy`` line rides it), join the pump."""
+        try:
+            self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        if self.proc.stdout is not None:
+            self.stdout_tail = self.proc.stdout.read().splitlines()[-20:]
+        self._thread.join(timeout=10)
+        return self.proc.returncode
+
+
+class ElasticTrainRunner:
+    """Supervise an elastic multi-process training run (module
+    docstring). ``base_argv`` is the train.py argv WITHOUT the
+    supervisor-owned flags (:func:`strip_owned_flags` cleans a raw
+    one); the runner appends per-generation rendezvous flags and
+    ``--resume`` from generation 1 on.
+
+    External membership events: :meth:`add_host` requests a +1 world
+    (the "a new host was granted" case — the current generation is
+    gracefully stopped via SIGTERM, which is ``fit()``'s
+    finish-epoch-and-save path, then relaunched wider). A rank dying
+    (preemption, chaos SIGKILL) shrinks the next generation to the
+    survivor count, floored at ``min_procs``.
+    """
+
+    def __init__(
+        self,
+        base_argv: List[str],
+        procs: int,
+        *,
+        min_procs: int = 1,
+        max_restarts: int = 8,
+        grace_s: float = 30.0,
+        poll_s: float = 0.2,
+        env: Optional[dict] = None,
+        cwd: Optional[str] = None,
+        resume_first: bool = False,
+    ):
+        if procs < 1:
+            raise ValueError("procs must be >= 1")
+        self.base_argv = list(base_argv)
+        # the caller asked generation 0 itself to --resume (a supervisor
+        # restarted around an existing run); later generations always do
+        self.resume_first = bool(resume_first)
+        self.world = int(procs)
+        self.min_procs = max(int(min_procs), 1)
+        self.max_restarts = int(max_restarts)
+        self.grace_s = float(grace_s)
+        self.poll_s = float(poll_s)
+        self.env = dict(os.environ if env is None else env)
+        self.cwd = cwd or os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        self.generations: List[dict] = []
+        # cross-thread state (tests drive add_host()/pids() from another
+        # thread while run() supervises): everything below the lock
+        self._lock = threading.Lock()
+        self._ranks: List[_Rank] = []
+        self._requested_world: Optional[int] = None
+        self._current_world = self.world
+
+    # -- external events ----------------------------------------------
+
+    def add_host(self) -> None:
+        """Request a +1 world size: the current generation is stopped
+        gracefully and relaunched wider — an added host is a resume,
+        not a restart."""
+        with self._lock:
+            self._requested_world = (
+                self._requested_world or self._current_world
+            ) + 1
+
+    def pids(self) -> Dict[int, int]:
+        """Live {rank: pid} of the current generation (chaos drills
+        aim their SIGKILLs with this)."""
+        with self._lock:
+            return {
+                r.rank: r.proc.pid for r in self._ranks if r.alive()
+            }
+
+    # -- one generation ------------------------------------------------
+
+    def _spawn_generation(self, gen: int, world: int) -> List[_Rank]:
+        argv = list(self.base_argv)
+        if gen > 0 or self.resume_first:
+            argv.append("--resume")
+        if world > 1:
+            coord = f"127.0.0.1:{_free_port()}"
+            argv += [
+                "--distributed", "--elastic",
+                "--dist_coord", coord,
+                "--dist_procs", str(world),
+            ]
+        else:
+            argv += ["--elastic"]
+        train_py = os.path.join(self.cwd, "train.py")
+        ranks = []
+        for rank in range(world):
+            cmd = [sys.executable, train_py, *argv]
+            if world > 1:
+                cmd += ["--dist_rank", str(rank)]
+            ranks.append(_Rank(rank, cmd, self.env, self.cwd))
+        with self._lock:
+            self._ranks = ranks
+        print(
+            f"==> elastic: generation {gen} world={world} pids="
+            f"{[r.proc.pid for r in ranks]}",
+            file=sys.stderr,
+        )
+        return ranks
+
+    def _stop_generation(self, ranks: List[_Rank]) -> List[int]:
+        """SIGTERM every live rank (graceful: finish the epoch, write
+        the preemption save), then reap with the SIGKILL backstop."""
+        for r in ranks:
+            if r.alive():
+                try:
+                    r.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        return [r.reap(self.grace_s) for r in ranks]
+
+    def run(self, timeout_s: float = 3600.0) -> dict:
+        """Supervise until a generation completes cleanly (every rank
+        exits 0 with no pending membership change), the restart budget
+        is exhausted, or the deadline passes. Returns the run record
+        (one entry per generation: world size, exit codes, the event
+        that ended it)."""
+        deadline = time.monotonic() + timeout_s
+        world = self.world
+        restarts = 0
+        completed = False
+        best_acc = None
+        for gen in range(self.max_restarts + 1):
+            with self._lock:
+                self._current_world = world
+            ranks = self._spawn_generation(gen, world)
+            event = "completed"
+            while True:
+                if time.monotonic() > deadline:
+                    event = "timeout"
+                    break
+                with self._lock:
+                    wanted = self._requested_world
+                if wanted is not None and wanted != world:
+                    event = f"scale:{world}->{wanted}"
+                    break
+                dead = [r for r in ranks if not r.alive()]
+                failed = [
+                    r for r in dead if r.proc.returncode != 0
+                ]
+                if failed:
+                    event = "preempted:rank%d:rc%d" % (
+                        failed[0].rank, failed[0].proc.returncode,
+                    )
+                    break
+                if len(dead) == len(ranks):
+                    break  # everyone exited cleanly on their own
+                time.sleep(self.poll_s)
+            rcs = self._stop_generation(ranks)
+            self.generations.append(
+                {"world": world, "rcs": rcs, "event": event}
+            )
+            print(
+                f"==> elastic: generation {gen} ended ({event}) "
+                f"rcs={rcs}",
+                file=sys.stderr,
+            )
+            for r in ranks:
+                for line in r.stdout_tail:
+                    if line.startswith("best test accuracy:"):
+                        try:
+                            best_acc = float(
+                                line.split(":")[1].strip().rstrip("%")
+                            )
+                        except ValueError:
+                            pass
+            if event == "timeout":
+                break
+            if event == "completed" and all(rc == 0 for rc in rcs):
+                completed = True
+                break
+            if event.startswith("scale:"):
+                world = max(int(event.split("->")[1]), self.min_procs)
+                with self._lock:
+                    self._requested_world = None
+            else:
+                # preemption: the next world is the survivor count —
+                # every rank with a clean/elastic exit survives in
+                # spirit (its host is still there); the preempted
+                # rank's slot is gone
+                died = sum(
+                    1 for rc in rcs
+                    if rc not in (0, ELASTIC_RC, -signal.SIGTERM)
+                )
+                world = max(world - max(died, 1), self.min_procs)
+            restarts += 1
+            print(
+                f"==> elastic: relaunching world={world} (--resume)",
+                file=sys.stderr,
+            )
+        return {
+            "harness": "elastic_train",
+            "completed": completed,
+            "restarts": restarts,
+            "final_world": world,
+            "generations": self.generations,
+            "best_acc": best_acc,
+        }
+
+
+def run_supervisor(config, argv: Optional[List[str]] = None) -> int:
+    """train.py's ``--elastic_procs N`` entry: supervise N ranks of
+    THIS command line. Prints the one-JSON-record contract on stdout."""
+    raw = list(sys.argv[1:] if argv is None else argv)
+    runner = ElasticTrainRunner(
+        strip_owned_flags(raw),
+        config.elastic_procs,
+        resume_first=config.resume,
+    )
+    record = runner.run()
+    print(json.dumps(record))
+    return 0 if record["completed"] else 1

@@ -84,7 +84,22 @@ class Trainer:
         # set before any tracing — jit traces lazily at first step call
         set_dense_grouped_conv(config.dense_grouped_conv)
         if config.distributed:
-            initialize_distributed()
+            if config.dist_coord and (
+                os.environ.get("JAX_PLATFORMS", "").strip().lower()
+                == "cpu"
+            ):
+                # explicit CPU rendezvous (tests, the elastic
+                # supervisor): without a cross-process collectives
+                # implementation the CPU client silently comes up
+                # single-process (same gate as serve.py's mesh ranks)
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            initialize_distributed(
+                config.dist_coord or None,
+                config.dist_procs or None,
+                config.dist_rank if config.dist_coord else None,
+            )
         # rank-aware logging: every rank gets its OWN file handler (a
         # straggler-host post-mortem needs that rank's epoch lines), but
         # non-zero ranks console-log at WARNING — N identical epoch lines
@@ -323,6 +338,20 @@ class Trainer:
                 self.start_epoch,
                 self.best_acc,
             )
+            if config.elastic and not config.evaluate:
+                # elastic resume (ROADMAP item 3): the restore above
+                # accepted whatever topology wrote the checkpoint (a v3
+                # save by M processes restores into any N-world —
+                # process 0 reassembles + broadcasts). Re-cut the
+                # on-disk layout to THIS world so the new topology's own
+                # saves, history, and inspectors see one consistent
+                # shard span. Process-0 only; peers already hold the
+                # broadcast state and never re-read the files.
+                from pytorch_cifar_tpu.train.checkpoint import (
+                    reshard_to_world,
+                )
+
+                reshard_to_world(self.ckpt_dir, registry=self.obs)
         self.state = replicate(state, self.mesh)
 
         # -- compiled steps -------------------------------------------

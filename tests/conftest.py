@@ -95,9 +95,11 @@ SLOW_TESTS = {
         "test_resnet18_checkpoint_serving_bit_identical",
     ),
     # the mesh-replica bench A/B spawns five train/serve subprocesses
-    # with a real 2-process gloo rendezvous (~3 min on 1 core)
+    # with a real 2-process gloo rendezvous (~3 min on 1 core); the
+    # elastic bench spawns two supervised fleet trees + a training run
     "test_bench.py": (
         "test_bench_serve_mesh_mode_prints_one_json_line",
+        "test_bench_serve_elastic_mode_prints_one_json_line",
     ),
 }
 

@@ -120,7 +120,7 @@ def main() -> int:
     )
     eval_step = data_parallel_eval_step(make_eval_step(axis_name=DATA_AXIS), mesh)
 
-    if mode in ("restore", "restore_fallback"):
+    if mode in ("restore", "restore_fallback", "reshard"):
         # cross-topology resume: restore a checkpoint that a DIFFERENT
         # mesh/process topology wrote. Checkpoints are host-side pytrees,
         # so the restore must be bit-exact regardless of the saving
@@ -141,6 +141,22 @@ def main() -> int:
             state2, start_epoch, best_acc = restore_checkpoint(
                 out_dir, state
             )
+        shards_after = None
+        if mode == "reshard":
+            # the elastic resume step (ROADMAP item 3): restore accepted
+            # whatever topology wrote the checkpoint; process 0 now
+            # re-cuts the on-disk layout to THIS world (one shard per
+            # process multihost, v2 single-host) — bit-identical payload
+            from pytorch_cifar_tpu.train.checkpoint import (
+                committed_shard_count,
+                reshard_to_world,
+            )
+
+            reshard_to_world(out_dir)
+            if pid == 0:
+                shards_after = committed_shard_count(
+                    out_dir, "ckpt.msgpack"
+                )
         ev = jax.device_get(
             eval_step(state2, put_global(te_x, te_y, sharding))
         )
@@ -158,6 +174,7 @@ def main() -> int:
                     "resumed_epoch": start_epoch,
                     "best_acc": best_acc,
                     "eval_acc": float(ev["correct"]) / float(ev["count"]),
+                    "shards_after": shards_after,
                 }
             ),
             flush=True,

@@ -291,6 +291,34 @@ def test_bench_serve_mesh_mode_prints_one_json_line():
     assert rec["warm_aot_hits"] == [3, 3]
 
 
+def test_bench_serve_elastic_mode_prints_one_json_line():
+    """--serve-elastic (the elastic fleet PR): the driver contract for
+    the autoscaling A/B — scale-out REACTION TIME (pressure onset →
+    the controller's warm replica serving) as the headline value, the
+    throughput-during-ramp ratio vs a fixed 1-replica fleet, and THE
+    warm-start pin: the scale-up replica joins with compiles == 0 from
+    the AOT cache the fixed run populated. Slow-marked (conftest): it
+    spawns two supervised fleet process trees plus a training run."""
+    rec, _ = run_bench(
+        ["--serve-elastic", "--model", "LeNet", "--steps", "2"],
+        timeout=900,
+    )
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"] == "serve_elastic_scaleout_LeNet_cpu", rec
+    assert rec["unit"] == "seconds"
+    assert rec["value"] > 0  # pressure onset -> new replica serving
+    assert rec["scaleup_compiles"] == 0  # warm from the shared cache
+    assert rec["scale_ups"] >= 1
+    assert rec["spawn_ms_p50"] > 0
+    # the A/B (a ratio is a measurement, not a schema guarantee on a
+    # 1-core box — presence and positivity are)
+    assert rec["elastic_img_per_sec"] > 0
+    assert rec["fixed_img_per_sec"] > 0
+    assert rec["elastic_vs_fixed"] > 0
+    assert rec["elastic_p99_ms"] > 0 and rec["fixed_p99_ms"] > 0
+    assert rec["failed"] == 0 and rec["requests"] > 0
+
+
 def test_parse_child_record_skips_non_record_json_lines():
     """headline()'s child-stdout parsing (ADVICE round 5): stray brace-
     prefixed lines — dependency JSON warnings, malformed braces — must

@@ -225,6 +225,32 @@ def test_zoo_drill_skewed_load_churn_and_replica_kill(tmp_path):
     assert rec["router_rc"] == 0
 
 
+def test_elastic_drill_ramp_kill_and_shed(tmp_path):
+    """--mode elastic (SERVING.md "Elastic fleet"; the ROADMAP item-3
+    acceptance): a fleet under FleetController authority (min 1 /
+    max 3) serves a load that ramps 10x and back while replica 0 is
+    SIGKILLed mid-ramp. Asserted: the fleet HOLDS at min under
+    baseline load, scales up under sustained pressure with every
+    scale-up replica joining WARM from the shared AOT cache
+    (compiles == 0), replaces the killed replica (reaped — no orphan),
+    sheds back toward min when the ramp ends, ZERO client-visible
+    errors in every phase, p99 bounded (ramp by the request deadline,
+    settled fleet by the steady-state budget), and /predict
+    bit-identical across EVERY replica that ever served."""
+    rec = run_chaos("elastic", tmp_path, extra=("--epochs", "2"))
+    assert rec["match"] is True
+    assert rec["held_at_min_baseline"] is True
+    assert rec["scaled_up_under_ramp"] is True
+    assert rec["bit_identical_all_generations"] is True
+    assert all(c == "0" for c in rec["scaleup_compiles"])
+    assert rec["scale_ups"] >= 2 and rec["scale_downs"] >= 1
+    assert rec["replica_failures"] >= 1  # the SIGKILL was seen + reaped
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    assert rec["p99_settle_ms"] <= rec["p99_budget_ms"]
+    assert rec["healthy_final"] >= 1
+    assert rec["fleet_rc"] == 0
+
+
 def test_canary_drill_bad_checkpoints_contained_good_promotes(tmp_path):
     """--mode canary (ROBUSTNESS.md "canary promotion"): under sustained
     mixed-priority HTTP load, NaN'd + bitflipped + regressed checkpoints

@@ -393,6 +393,109 @@ def test_remove_stale_last_removes_v3_shards(tmp_path, lenet_state):
     assert leftovers == []
 
 
+def test_reshard_v3_to_v2_bit_identical(tmp_path, lenet_state):
+    """The elastic M→1 topology change (ROADMAP item 3): a v3 save by 2
+    processes re-cut for a 1-process world is BIT-identical to a v2
+    save of the same state — payload bytes, epoch, best_acc — and the
+    superseded shard files are gone."""
+    out = str(tmp_path / "v3")
+    save_checkpoint(out, lenet_state, 5, 42.0, num_shards=2)
+    save_checkpoint(str(tmp_path / "v2"), lenet_state, 5, 42.0)
+    ckpt.reshard_checkpoint(out, num_shards=1)
+    with open(tmp_path / "v2" / "ckpt.msgpack", "rb") as f:
+        v2 = f.read()
+    with open(tmp_path / "v3" / "ckpt.msgpack", "rb") as f:
+        resharded = f.read()
+    assert resharded == v2
+    meta = json.load(open(os.path.join(out, "ckpt.json")))
+    assert "shards" not in meta
+    assert meta["epoch"] == 5 and meta["best_acc"] == pytest.approx(42.0)
+    assert not [f for f in os.listdir(out) if "shard" in f]
+    # and the restore is bit-identical to a same-topology restore
+    a, ep_a, _ = restore_checkpoint(out, lenet_state)
+    b, ep_b, _ = restore_checkpoint(str(tmp_path / "v2"), lenet_state)
+    assert ep_a == ep_b == 6
+    _assert_state_equal(a, b)
+
+
+def test_reshard_v2_to_v3_bit_identical(tmp_path, lenet_state):
+    """The reverse (1→2, a grown world): the re-cut shard set
+    reassembles to the exact v2 payload, the monolithic file is
+    retired, and restore matches the same-topology restore."""
+    out = str(tmp_path)
+    save_checkpoint(out, lenet_state, 3, 7.0)
+    with open(os.path.join(out, "ckpt.msgpack"), "rb") as f:
+        v2 = f.read()
+    ckpt.reshard_checkpoint(out, num_shards=2)
+    assert ckpt.committed_shard_count(out, "ckpt.msgpack") == 2
+    assert not os.path.exists(os.path.join(out, "ckpt.msgpack"))
+    assert ckpt.read_verified_payload(out, "ckpt.msgpack") == v2
+    restored, epoch, best = restore_checkpoint(out, lenet_state)
+    assert epoch == 4 and best == pytest.approx(7.0)
+    _assert_state_equal(lenet_state, restored)
+
+
+def test_restore_accepts_any_saved_topology(tmp_path, lenet_state):
+    """The elastic restore contract: a v3 save by M shards restores in
+    a world of N for any M (process 0 reassembles the committed set) —
+    bit-identical to the same-topology restore, pinned across several
+    forced M."""
+    ref_dir = str(tmp_path / "ref")
+    save_checkpoint(ref_dir, lenet_state, 1, 1.0)
+    ref, _, _ = restore_checkpoint(ref_dir, lenet_state)
+    for m in (2, 3, 5):
+        out = str(tmp_path / f"m{m}")
+        save_checkpoint(out, lenet_state, 1, 1.0, num_shards=m)
+        restored, epoch, _ = restore_checkpoint(out, lenet_state)
+        assert epoch == 2
+        _assert_state_equal(ref, restored)
+
+
+def test_reshard_noop_and_missing(tmp_path, lenet_state):
+    out = str(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        ckpt.reshard_checkpoint(out, num_shards=2)
+    save_checkpoint(out, lenet_state, 1, 1.0, num_shards=2)
+    before = sorted(os.listdir(out))
+    ckpt.reshard_checkpoint(out, num_shards=2)  # same topology: no-op
+    assert sorted(os.listdir(out)) == before
+
+
+def test_reshard_to_world_recuts_both_resume_candidates(
+    tmp_path, lenet_state
+):
+    """The trainer's elastic resume hook: both files the resume order
+    may read (best + preemption save) are re-cut to the current world
+    (single-process here → v2), corrupt candidates are skipped loudly
+    rather than crashing the resume."""
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+
+    out = str(tmp_path)
+    save_checkpoint(out, lenet_state, 1, 1.0, num_shards=2)
+    save_checkpoint(
+        out, lenet_state, 2, 1.0, name=LAST_NAME, num_shards=2
+    )
+    reg = MetricsRegistry()
+    ckpt.reshard_to_world(out, registry=reg)
+    assert ckpt.committed_shard_count(out, "ckpt.msgpack") == 1
+    assert ckpt.committed_shard_count(out, LAST_NAME) == 1
+    assert reg.counter("checkpoint.reshards").value == 2.0
+    restored, epoch, _ = restore_checkpoint(
+        out, lenet_state, names=ckpt.newest_checkpoint_order(out)
+    )
+    assert epoch == 3
+    _assert_state_equal(lenet_state, restored)
+    # a corrupt candidate is skipped (restore's fallback owns it): a
+    # fresh 2-shard preemption save with a torn shard must not crash
+    # the resume's reshard — and is left untouched for restore to judge
+    save_checkpoint(
+        out, lenet_state, 4, 1.0, name=LAST_NAME, num_shards=2
+    )
+    faults.truncate_file(os.path.join(out, shard_name(LAST_NAME, 1, 2)))
+    ckpt.reshard_to_world(out)  # must not raise
+    assert ckpt.committed_shard_count(out, LAST_NAME) == 2  # untouched
+
+
 def test_num_shards_must_match_process_count_rule(tmp_path, lenet_state):
     # single process: any shard count is allowed (tests/tools); the
     # multihost n != process_count rejection can only fire multi-process

@@ -1,0 +1,392 @@
+"""Elastic fleet controller (serve/fleet.py; SERVING.md "Elastic
+fleet") — tier-1 unit tests.
+
+Everything here is deterministic and subprocess-free: the policy state
+machine takes an injectable clock and is driven through
+``control_once(now=...)`` with fake scrape signals, a fake replica
+launcher, and a REAL (unstarted) Router — so every hysteresis window,
+cooldown, floor, and bound is replayed exactly, no sleeps anywhere.
+The process-tree half (real serve.py replicas spawned/drained under
+load) lives in the chaos drill (``tools/chaos_run.py --mode elastic``,
+tests/test_chaos.py) and ``bench.py --serve-elastic``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pytorch_cifar_tpu.obs import MetricsRegistry
+from pytorch_cifar_tpu.serve.fleet import (
+    FleetController,
+    FleetPolicy,
+    FleetSignals,
+    ScalingEvaluator,
+    parse_prom_counter,
+    parse_prom_histogram_percentile,
+)
+from pytorch_cifar_tpu.serve.router import Router
+
+
+class FakeReplica:
+    """A launcher product with the ReplicaProcess surface the controller
+    uses: url/health/alive()/decommission()."""
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.url = f"http://127.0.0.1:{9000 + idx}"
+        self.pid = 1000 + idx
+        self.health = {"compiles": 0, "aot_cache_hits": 3}
+        self.dead = False
+        self.drained = False
+
+    def alive(self):
+        return not self.dead
+
+    def decommission(self, timeout_s=60.0):
+        self.dead = True
+        self.drained = True
+        return 0.01
+
+
+def make_fleet(policy=None, seeds=1, registry=None):
+    """A controller over a real (unstarted) Router, a fake launcher,
+    a fake clock, and mutable scrape signals. Returns (controller,
+    clock dict, signals holder, spawned list)."""
+    policy = policy or FleetPolicy(
+        min_replicas=1,
+        max_replicas=3,
+        queue_high=8.0,
+        queue_low=1.0,
+        up_after_s=2.0,
+        down_after_s=10.0,
+        up_cooldown_s=5.0,
+        down_cooldown_s=20.0,
+    )
+    spawned = []
+
+    def launcher(idx):
+        r = FakeReplica(idx)
+        spawned.append(r)
+        return r
+
+    clk = {"t": 0.0}
+    sig = {"s": FleetSignals(healthy=seeds)}
+    seed_handles = [FakeReplica(i) for i in range(seeds)]
+    router = Router([h.url for h in seed_handles])  # never start()ed
+    ctl = FleetController(
+        router,
+        launcher,
+        policy,
+        scrape=lambda: sig["s"],
+        registry=registry or MetricsRegistry(),
+        clock=lambda: clk["t"],
+    )
+    for h in seed_handles:
+        ctl.adopt(h)
+    return ctl, clk, sig, spawned, seed_handles
+
+
+def pressured(n, queued=40):
+    return FleetSignals(healthy=n, queued=queued, in_flight=n)
+
+
+def idle(n):
+    return FleetSignals(healthy=n, queued=0, in_flight=0)
+
+
+# ---------------------------------------------------------------------
+# scale-up: sustained pressure, hysteresis, cooldown, max bound
+# ---------------------------------------------------------------------
+
+
+def test_scale_up_requires_sustained_pressure():
+    ctl, clk, sig, spawned, _ = make_fleet()
+    sig["s"] = pressured(1)
+    assert ctl.control_once(now=0.0) == "hold"  # pressure starts
+    assert ctl.control_once(now=1.9) == "hold"  # not sustained yet
+    assert spawned == []
+    assert ctl.control_once(now=2.0) == "up"  # up_after_s reached
+    assert len(spawned) == 1
+    assert len(ctl.replicas()) == 2
+    assert len(ctl.router.replicas) == 2  # registered live
+    assert ctl.stats["scale_ups"] == 1
+    assert ctl.obs.gauge("serve.fleet.replicas").value == 2.0
+
+
+def test_scale_up_cooldown_then_max_bound():
+    ctl, clk, sig, spawned, _ = make_fleet()
+    sig["s"] = pressured(1)
+    ctl.control_once(now=0.0)
+    assert ctl.control_once(now=2.0) == "up"
+    # pressure persists: the window re-accrues from the next sweep and
+    # the up-cooldown (5 s since the action at t=2) must both pass
+    sig["s"] = pressured(2)
+    assert ctl.control_once(now=3.0) == "hold"  # cooling down
+    assert ctl.control_once(now=6.0) == "hold"  # cooled at 7, not yet
+    assert ctl.control_once(now=7.5) == "up"    # sustained + cooled
+    assert len(ctl.replicas()) == 3
+    # at max_replicas the fleet holds no matter the pressure
+    sig["s"] = pressured(3)
+    assert ctl.control_once(now=30.0) == "hold"
+    assert ctl.control_once(now=60.0) == "hold"
+    assert ctl.stats["scale_ups"] == 2
+
+
+def test_pressure_window_resets_inside_band():
+    """A pressure blip that returns to the band must NOT accumulate:
+    the sustained window restarts when pressure resumes."""
+    ctl, clk, sig, spawned, _ = make_fleet()
+    sig["s"] = pressured(1)
+    ctl.control_once(now=0.0)
+    sig["s"] = FleetSignals(healthy=1, queued=4)  # inside the band
+    assert ctl.control_once(now=1.0) == "hold"
+    sig["s"] = pressured(1)
+    assert ctl.control_once(now=1.5) == "hold"  # window restarted
+    assert ctl.control_once(now=3.0) == "hold"  # 1.5 s of pressure
+    assert ctl.control_once(now=3.6) == "up"    # 2.1 s sustained
+
+
+def test_deadline_expiries_trigger_pressure():
+    """An expiry delta counts as pressure even at low queue load — a
+    missed deadline is never acceptable steady state."""
+    ctl, clk, sig, spawned, _ = make_fleet()
+    sig["s"] = FleetSignals(healthy=1, queued=0, deadline_expired=2.0)
+    assert ctl.control_once(now=0.0) == "hold"
+    # the counter keeps growing: sustained expiry pressure
+    sig["s"] = FleetSignals(healthy=1, queued=0, deadline_expired=5.0)
+    assert ctl.control_once(now=2.5) == "up"
+    # and once the counter stops moving (no NEW expiries), the same
+    # cumulative value is not pressure anymore
+    assert ctl.evaluator.evaluate(
+        FleetSignals(healthy=2, queued=0, deadline_expired=5.0), 2, 60.0
+    )[0] != "up"
+
+
+def test_p99_bound_triggers_pressure():
+    policy = FleetPolicy(
+        min_replicas=1, max_replicas=2, p99_high_ms=100.0,
+        up_after_s=1.0, up_cooldown_s=1.0,
+    )
+    ctl, clk, sig, spawned, _ = make_fleet(policy=policy)
+    sig["s"] = FleetSignals(healthy=1, queued=0, p99_ms=250.0)
+    assert ctl.control_once(now=0.0) == "hold"
+    assert ctl.control_once(now=1.0) == "up"
+
+
+# ---------------------------------------------------------------------
+# scale-down: sustained idle, free drain only, min bound
+# ---------------------------------------------------------------------
+
+
+def test_scale_down_requires_sustained_idle_and_respects_min():
+    ctl, clk, sig, spawned, seeds = make_fleet(seeds=2)
+    sig["s"] = idle(2)
+    assert ctl.control_once(now=0.0) == "hold"
+    assert ctl.control_once(now=9.9) == "hold"
+    assert ctl.control_once(now=10.0) == "down"
+    assert len(ctl.replicas()) == 1
+    assert len(ctl.router.replicas) == 1
+    assert ctl.stats["scale_downs"] == 1
+    # the drained replica really was decommissioned, newest-first
+    drained = [h for h in seeds if h.drained]
+    assert len(drained) == 1 and drained[0].idx == 1
+    # at min_replicas idle holds forever
+    sig["s"] = idle(1)
+    assert ctl.control_once(now=100.0) == "hold"
+    assert ctl.control_once(now=1000.0) == "hold"
+    assert len(ctl.replicas()) == 1
+
+
+def test_scale_down_only_when_drain_is_free():
+    """A replica with router-side in-flight work (or a probed queue)
+    never drains — scale-down must cost nothing."""
+    ctl, clk, sig, spawned, seeds = make_fleet(seeds=2)
+    sig["s"] = idle(2)
+    assert ctl.control_once(now=0.0) == "hold"  # idle window opens
+    for r in ctl.router.replicas:
+        r.in_flight = 1  # both replicas hold work
+    assert ctl.control_once(now=15.0) == "hold"  # sustained, no victim
+    assert ctl.stats["scale_downs"] == 0
+    for r in ctl.router.replicas:
+        r.in_flight = 0
+    assert ctl.control_once(now=16.0) == "down"
+
+
+def test_scale_down_cooldown():
+    policy = FleetPolicy(
+        min_replicas=1, max_replicas=4, down_after_s=1.0,
+        down_cooldown_s=30.0,
+    )
+    ctl, clk, sig, spawned, _ = make_fleet(policy=policy, seeds=3)
+    sig["s"] = idle(3)
+    ctl.control_once(now=0.0)
+    assert ctl.control_once(now=1.0) == "down"
+    # idle persists but the down-cooldown gates the next drain
+    assert ctl.control_once(now=5.0) == "hold"
+    assert ctl.control_once(now=30.9) == "hold"
+    assert ctl.control_once(now=31.5) == "down"
+    assert len(ctl.replicas()) == 1
+
+
+# ---------------------------------------------------------------------
+# failure handling: the min-replicas floor and scrape errors
+# ---------------------------------------------------------------------
+
+
+def test_dead_replica_reaped_and_replaced_immediately():
+    """A SIGKILLed replica is deregistered, reaped, and replaced by the
+    floor — bypassing pressure windows and cooldowns (an outage is not
+    a load signal)."""
+    ctl, clk, sig, spawned, seeds = make_fleet()
+    sig["s"] = idle(1)
+    seeds[0].dead = True  # preempted
+    assert ctl.control_once(now=0.0) == "replace"
+    assert ctl.stats["replica_failures"] == 1
+    assert len(ctl.replicas()) == 1
+    assert len(spawned) == 1
+    # the corpse is out of rotation, the replacement in
+    urls = [r.url for r in ctl.router.replicas]
+    assert seeds[0].url not in urls
+    assert spawned[0].url in urls
+    assert seeds[0].drained  # reaped, never orphaned
+
+
+def test_failed_spawn_holds_without_eating_cooldown():
+    """A spawn failure counts a replica_failure and retries on the next
+    sweep — the cooldown stamps only on success."""
+    calls = {"n": 0}
+
+    def flaky_launcher(idx):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("no capacity")
+        return FakeReplica(idx)
+
+    policy = FleetPolicy(min_replicas=1, max_replicas=2, up_after_s=1.0)
+    clk = {"t": 0.0}
+    sig = {"s": pressured(1)}
+    seed = FakeReplica(0)
+    router = Router([seed.url])
+    ctl = FleetController(
+        router, flaky_launcher, policy,
+        scrape=lambda: sig["s"], clock=lambda: clk["t"],
+    )
+    ctl.adopt(seed)
+    assert ctl.control_once(now=0.0) == "hold"
+    assert ctl.control_once(now=1.0) == "hold"  # spawn raised
+    assert ctl.stats["replica_failures"] == 1
+    assert ctl.control_once(now=1.5) == "up"  # retried, no cooldown wait
+    assert len(ctl.replicas()) == 2
+
+
+def test_scrape_error_holds_and_counts():
+    ctl, clk, sig, spawned, _ = make_fleet()
+
+    def broken():
+        raise OSError("fleet edge unreachable")
+
+    ctl.scrape = broken
+    assert ctl.control_once(now=0.0) == "hold"
+    assert ctl.stats["scrape_errors"] == 1
+    assert spawned == []
+
+
+# ---------------------------------------------------------------------
+# router membership hooks
+# ---------------------------------------------------------------------
+
+
+def test_router_add_remove_replica_hooks():
+    router = Router(["http://127.0.0.1:9100"])
+    added = router.add_replica("http://127.0.0.1:9101")
+    assert len(router.replicas) == 2
+    # idempotent: re-adding returns the existing entry
+    assert router.add_replica("http://127.0.0.1:9101") is added
+    assert len(router.replicas) == 2
+    removed = router.remove_replica("http://127.0.0.1:9101")
+    assert removed is added
+    assert len(router.replicas) == 1
+    assert router.remove_replica("http://127.0.0.1:9101") is None
+    # the healthy-replica gauge tracked both transitions
+    assert router.obs.gauge("router.healthy_replicas").value == 1.0
+
+
+def test_router_fleet_view_snapshot():
+    router = Router(["http://127.0.0.1:9100", "http://127.0.0.1:9101"])
+    router.replicas[0].in_flight = 3
+    router.replicas[1].last_health = {"queued": {"interactive": 2}}
+    view = router.fleet_view()
+    assert view["http://127.0.0.1:9100"][0] == 3
+    assert view["http://127.0.0.1:9101"][1] == {
+        "queued": {"interactive": 2}
+    }
+
+
+# ---------------------------------------------------------------------
+# signal scraping / parsing
+# ---------------------------------------------------------------------
+
+
+def test_fleet_signals_from_http_payloads():
+    health = {
+        "healthy_replicas": 2,
+        "replicas": [
+            {
+                "in_flight": 3,
+                "health": {"queued": {"interactive": 4, "bulk": 2}},
+            },
+            {"in_flight": 1, "health": {}},  # mid-join: no queue stats
+        ],
+    }
+    prom = "\n".join(
+        [
+            "pct_serve_http_504 7",
+            'pct_router_latency_ms_bucket{le="10"} 90',
+            'pct_router_latency_ms_bucket{le="100"} 99',
+            'pct_router_latency_ms_bucket{le="+Inf"} 100',
+        ]
+    )
+    s = FleetSignals.from_http(health, prom)
+    assert s.healthy == 2
+    assert s.queued == 6 and s.bulk_queued == 2
+    assert s.in_flight == 4
+    assert s.deadline_expired == 7.0
+    assert s.p99_ms == 100.0
+    assert s.load_per_replica == pytest.approx(5.0)
+    # tolerant of an empty fleet payload
+    empty = FleetSignals.from_http({}, "")
+    assert empty.healthy == 0 and empty.load_per_replica == 0.0
+
+
+def test_prom_parsers():
+    text = "pct_x_total 3\npct_h_bucket{le=\"1\"} 0\n"
+    assert parse_prom_counter(text, "pct_x_total") == 3.0
+    assert parse_prom_counter(text, "pct_absent") == 0.0
+    assert parse_prom_histogram_percentile(text, "pct_h", 99) == 0.0
+    assert parse_prom_histogram_percentile("", "pct_h", 99) == 0.0
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        FleetPolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        FleetPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        FleetPolicy(queue_low=9.0, queue_high=8.0)
+
+
+def test_evaluator_is_pure_state_machine():
+    """The evaluator alone (no controller): band transitions reset the
+    windows, actions stamp cooldowns only via acted_* callbacks."""
+    p = FleetPolicy(min_replicas=1, max_replicas=4, up_after_s=2.0)
+    ev = ScalingEvaluator(p)
+    assert ev.evaluate(pressured(1), 1, 0.0)[0] == "hold"
+    action, reason = ev.evaluate(pressured(1), 1, 2.5)
+    assert action == "up" and "load" in reason
+    # the controller never actuated (e.g. spawn failed): no cooldown
+    action, _ = ev.evaluate(pressured(1), 1, 2.6)
+    assert action == "up"
+    ev.acted_up(2.6)
+    assert ev.evaluate(pressured(2), 2, 3.0)[0] == "hold"
+    # the floor verdict bypasses every window
+    assert ev.evaluate(idle(0), 0, 3.1) == ("up", "min-replicas floor")

@@ -62,6 +62,8 @@ def test_rule_registry_has_at_least_sixteen_rules():
     # the PR 8 additions are registered
     for name in ("thread-collective", "atomic-publish", "thread-join"):
         assert name in rule_names()
+    # the elastic-fleet PR's subprocess rule (the orphan-replica class)
+    assert "subprocess-lifecycle" in rule_names()
     # the concurrency-protocol rules (lint/locks.py) + the obs-docs gate
     for name in (
         "lock-order-inversion", "blocking-under-lock",
@@ -875,7 +877,76 @@ def test_thread_join_negative(tmp_path):
     assert run_rule(tmp_path, src, "thread-join") == []
 
 
-def test_atomic_publish_positive(tmp_path):
+def test_subprocess_lifecycle_positive(tmp_path):
+    # the orphan-replica shapes the elastic fleet controller's
+    # decommission path must never produce: a class that stores a child
+    # no method ever reaps, a function-local child dropped on every
+    # exit path, and the fire-and-forget Popen with no handle at all
+    src = """
+    import subprocess
+
+    class Fleet:
+        def spawn(self):
+            self.proc = subprocess.Popen(["serve"])
+
+    def launch_and_forget(cmd):
+        p = subprocess.Popen(cmd)
+        return p.pid  # pid escapes, the HANDLE does not
+
+    def no_handle(cmd):
+        subprocess.Popen(cmd)
+    """
+    found = run_rule(tmp_path, src, "subprocess-lifecycle")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 3, msgs
+    assert "self.proc" in msgs
+    assert "'p'" in msgs
+    assert "without keeping the handle" in msgs
+
+
+def test_subprocess_lifecycle_negative(tmp_path):
+    # the repo's real shapes: communicate (chaos_run), wait-with-kill
+    # backstop via a self alias (fleet.ReplicaProcess.decommission),
+    # ownership transfer by argument / return / container / attr store
+    # (router_run's ReplicaProc + bench's mesh proc list), and
+    # subprocess.run (no handle to manage at all)
+    src = """
+    import subprocess
+
+    class Replica:
+        def spawn(self):
+            self.proc = subprocess.Popen(["serve"])
+
+        def decommission(self):
+            p = self.proc
+            try:
+                p.wait(timeout=60)
+            except Exception:
+                p.kill()
+                p.wait()
+
+    def drive(cmd):
+        proc = subprocess.Popen(cmd)
+        out, err = proc.communicate(timeout=900)
+        return out
+
+    def spawn_for(owner, cmd):
+        child = subprocess.Popen(cmd)
+        owner.adopt(child)  # ownership transferred, owner reaps
+
+    def spawn_ranked(cmds, registry):
+        for i, cmd in enumerate(cmds):
+            q = subprocess.Popen(cmd)
+            registry[i] = q  # container owns it
+
+    def launcher(cmd):
+        handle = subprocess.Popen(cmd)
+        return handle  # caller owns it
+
+    def blocking(cmd):
+        return subprocess.run(cmd, capture_output=True)
+    """
+    assert run_rule(tmp_path, src, "subprocess-lifecycle") == []
     src = """
     import json
     import os

@@ -136,6 +136,104 @@ def test_cross_topology_checkpoint_resume(tmp_path):
     assert back["resumed_epoch"] == 2
 
 
+def test_elastic_reshard_follows_world_size(tmp_path):
+    """The elastic-training reshard pin over a REAL gloo world
+    (ROADMAP item 3): a v3 save written by 2 processes resumes in a
+    1-process world bit-identically to the same-topology restore, the
+    resumed world re-cuts the on-disk layout to its own topology
+    (2 shards → v2), and the reverse direction (v2 → a grown 2-process
+    world → 2 shards) holds too."""
+    out = str(tmp_path / "mh")
+    two = _run_workers(2, 4, out)  # trains + saves v3 (2 shards)
+    meta = json.loads((tmp_path / "mh" / "ckpt.json").read_text())
+    assert len(meta["shards"]) == 2
+
+    # 2 -> 1: restore is bit-exact, layout re-cut to v2
+    one = _run_workers(1, 8, out, extra_args=("reshard",))[0]
+    assert one["psum"] == pytest.approx(two[0]["psum"], rel=1e-12)
+    assert one["resumed_epoch"] == 2
+    assert one["shards_after"] == 1
+    meta = json.loads((tmp_path / "mh" / "ckpt.json").read_text())
+    assert "shards" not in meta  # monolithic v2 now
+
+    # 1 -> 2: the grown world restores the v2 layout bit-exactly and
+    # re-cuts it to one shard per process
+    back = _run_workers(2, 4, out, extra_args=("reshard",))
+    for r in back:
+        assert r["psum"] == pytest.approx(two[0]["psum"], rel=1e-12)
+        assert r["resumed_epoch"] == 2
+    assert back[0]["shards_after"] == 2
+    meta = json.loads((tmp_path / "mh" / "ckpt.json").read_text())
+    assert len(meta["shards"]) == 2
+    # both directions produced restorable, verified layouts throughout
+    assert sum(s["size"] for s in meta["shards"]) == meta["total"]["size"]
+
+
+def test_elastic_training_preemption_and_growth(tmp_path):
+    """The training half of ROADMAP item 3 end-to-end: a 2-rank elastic
+    run loses rank 1 to SIGKILL (preemption) → the supervisor reaps the
+    generation and relaunches the SURVIVING world (1 rank) with
+    --resume from the last durable checkpoint; an added host then grows
+    the world back to 2 (graceful stop → relaunch wider → resume).
+    The run completes (a preemption is a resume, not a restart) with
+    the restart ledger naming both membership events."""
+    import signal as _signal
+    import threading
+    import time
+
+    from pytorch_cifar_tpu.train.elastic import ElasticTrainRunner
+
+    out = str(tmp_path / "ckpt")
+    base = [
+        "--model", "LeNet", "--synthetic_data",
+        "--synthetic_train_size", "256", "--synthetic_test_size", "128",
+        "--batch_size", "64", "--epochs", "6", "--no-amp",
+        "--output_dir", out, "--log_every", "100000",
+        "--checkpoint_every", "0", "--async_save", "off",
+    ]
+    env = _env(2)
+    runner = ElasticTrainRunner(base, 2, grace_s=30.0, env=env)
+    result: dict = {}
+    t = threading.Thread(
+        target=lambda: result.update(runner.run(timeout_s=600))
+    )
+    t.start()
+    try:
+        # phase 1 — preemption: wait for the first durable checkpoint,
+        # then SIGKILL rank 1 mid-run
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and not os.path.exists(
+            os.path.join(out, "ckpt.json")
+        ):
+            time.sleep(0.25)
+        assert os.path.exists(os.path.join(out, "ckpt.json"))
+        time.sleep(0.5)
+        pids = runner.pids()
+        if 1 in pids:  # rank 1 may have little time left; kill if alive
+            os.kill(pids[1], _signal.SIGKILL)
+        # phase 2 — growth: once the survivor generation (world 1, a
+        # single rank 0) is up, grant it a second host
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if len(runner.generations) >= 1 and set(runner.pids()) == {0}:
+                runner.add_host()
+                break
+            time.sleep(0.25)
+    finally:
+        t.join(timeout=600)
+    assert not t.is_alive()
+    assert result["completed"] is True
+    events = [g["event"] for g in result["generations"]]
+    assert any(e.startswith("preempted:rank1") for e in events), events
+    assert any(e.startswith("scale:") for e in events), events
+    assert result["final_world"] == 2
+    # the final world (2 ranks) left a 2-shard v3 layout behind: the
+    # elastic resume re-cut the grown world's checkpoint on entry
+    # (reshard_to_world) and its own saves stayed per-process sharded
+    meta = json.loads((tmp_path / "ckpt" / "ckpt.json").read_text())
+    assert len(meta["shards"]) == 2
+
+
 def test_multiprocess_corrupt_fallback_restore(tmp_path):
     """Acceptance (a) on multiple processes: a corrupt newest checkpoint
     makes restore fall back — and BOTH processes agree on the fallback

@@ -380,3 +380,33 @@ def test_pipelined_fit_finalizes_pending_epoch_on_crash(tmp_path):
     # time; unwind must have fetched its eval and written its checkpoint
     assert tr.best_acc > 0
     assert os.path.exists(os.path.join(cfg.output_dir, "ckpt.msgpack"))
+
+
+def test_elastic_supervisor_argv_contract():
+    """train/elastic.py's per-generation argv derivation: supervisor-
+    owned flags (rendezvous, world size, rank, --distributed/--resume/
+    --elastic) are stripped from the base argv — the runner re-adds all
+    of them with the CURRENT generation's values — and a user-requested
+    --resume survives into generation 0 via resume_first."""
+    from pytorch_cifar_tpu.train.elastic import (
+        ELASTIC_RC,
+        ElasticTrainRunner,
+        strip_owned_flags,
+    )
+
+    argv = [
+        "--model", "LeNet", "--elastic_procs", "2",
+        "--dist_coord", "localhost:1234", "--dist_procs", "2",
+        "--dist_rank=1", "--distributed", "--elastic", "--resume",
+        "--epochs", "3",
+    ]
+    assert strip_owned_flags(argv) == [
+        "--model", "LeNet", "--epochs", "3"
+    ]
+    # the rank contract the supervisor keys on (EX_TEMPFAIL: "membership
+    # changed, resume me"; serve's mesh watchdog owns 70)
+    assert ELASTIC_RC == 75
+    runner = ElasticTrainRunner(["--epochs", "1"], 2, resume_first=True)
+    assert runner.resume_first is True
+    with pytest.raises(ValueError):
+        ElasticTrainRunner(["--epochs", "1"], 0)

@@ -70,6 +70,17 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
              (router hedges absorb the loss), re-admitted tenants must
              report aot_cache hits with compile_count == 0, and the
              router must evict the corpse and exit 0 at drain.
+  elastic  — autoscaling drill (SERVING.md "Elastic fleet"; the
+             ROADMAP item-3 acceptance): a fleet under
+             tools/fleet_run.py authority (min 1 / max 3 replicas)
+             serves a load that ramps 10x and back while replica 0 is
+             SIGKILLed mid-ramp. The controller must scale up on the
+             sustained pressure (every scale-up replica joining WARM
+             from the shared AOT cache — compiles == 0), replace the
+             killed replica (reaped, never orphaned), and scale back
+             down when the ramp ends — with ZERO client-visible
+             errors in every phase, p99 bounded, and /predict
+             bit-identical across every replica that ever served.
   router   — fleet drill (SERVING.md "HTTP frontend & router"): a
              2-replica fleet behind tools/router_run.py serves sustained
              mixed-priority HTTP load; one replica is SIGKILLed
@@ -423,6 +434,310 @@ def serve_drill(args, work: str) -> dict:
         "ckpt_epoch_served": rec3["ckpt_epoch"],
         "compiles": rec3["compiles"],
         "killed_rc": killed_rc,
+    }
+
+
+def elastic_drill(args, work: str) -> dict:
+    """The autoscaling drill (module docstring; ROADMAP item 3).
+
+    Phases:
+      0. fleet-up: fleet_run.py with min 1 / max 3 replicas and an
+         aggressive band (up after 0.5 s of pressure), replica 0
+         populating the shared AOT cache. A stderr-watcher thread
+         tracks every membership line (seed / scale-up / scale-down /
+         died) so the drill can probe bit-identity on EVERY replica
+         that ever serves, the moment it appears.
+      1. baseline: 1 closed-loop client -> p99_steady; the fleet must
+         HOLD at 1 replica (load inside the band).
+      2. ramp 10x: 10 clients for ~35 s. The controller must scale up
+         (warm: compiles == 0); once the fleet is >= 2, replica 0 is
+         SIGKILLed mid-load — the router hedges the in-flight loss
+         (zero client-visible errors), the controller reaps the corpse
+         and refills. Every replica that appears is probed bit-equal
+         to the pre-drill reference answer.
+      3. ramp back: 1 client again for ~20 s; the controller must
+         scale DOWN toward min (drains cost nothing: zero in-flight).
+      4. drain: SIGTERM to fleet_run exits 0 with the scale ledger in
+         its JSON record; every child is reaped (no orphan replicas).
+    """
+    import threading
+
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+
+    ckpt_dir = os.path.join(work, "ckpt")
+    print(f"==> [elastic] training checkpoint -> {ckpt_dir}",
+          file=sys.stderr)
+    run_to_completion(train_cmd(args, ckpt_dir), child_env(), args.timeout)
+
+    env = child_env()
+    env.pop("XLA_FLAGS", None)  # replicas: production 1-device shape
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "fleet_run.py"),
+        "--ckpt", ckpt_dir,
+        "--model", args.model,
+        "--min_replicas", "1",
+        "--max_replicas", "3",
+        "--buckets", "1", "4", "8",
+        "--aot_cache", os.path.join(work, "aot"),
+        "--deadline_ms", "4000",
+        "--max_wait_ms", "1",
+        "--probe_s", "0.2",
+        "--control_interval_s", "0.25",
+        "--queue_high", "3",
+        "--queue_low", "2",
+        "--up_after_s", "0.5",
+        "--down_after_s", "2",
+        "--up_cooldown_s", "1.5",
+        "--down_cooldown_s", "2",
+    ]
+    print("==> [elastic] fleet up (min 1, max 3)", file=sys.stderr)
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+
+    seed_re = re.compile(
+        r"==> fleet: replica (\d+) pid=(\d+) url=(\S+) compiles=(\S+)"
+    )
+    up_re = re.compile(
+        r"==> fleet: scale-up replica (\d+) url=(\S+) pid=(\d+) "
+        r"compiles=(\S+)"
+    )
+    down_re = re.compile(r"==> fleet: scale-down replica (\d+) url=(\S+)")
+    died_re = re.compile(r"==> fleet: replica (\d+) died; removed")
+    fleet_re = re.compile(r"==> fleet: serving on (\S+)")
+
+    # membership ledger, fed by the stderr watcher: every replica that
+    # EVER served, with its pid/compiles; guarded by a lock (the drill
+    # thread probes from it while the watcher appends)
+    state_lock = threading.Lock()
+    members = {}  # idx -> {"url", "pid", "compiles"}
+    events = {"ups": 0, "downs": 0, "died": 0}
+    fleet_url_box = {}
+    fleet_ready = threading.Event()
+
+    def watch_stderr():
+        for line in proc.stderr:
+            sys.stderr.write(line)
+            m = seed_re.search(line)
+            if m:
+                with state_lock:
+                    members[int(m.group(1))] = {
+                        "url": m.group(3), "pid": int(m.group(2)),
+                        "compiles": m.group(4),
+                    }
+            m = up_re.search(line)
+            if m:
+                with state_lock:
+                    members[int(m.group(1))] = {
+                        "url": m.group(2), "pid": int(m.group(3)),
+                        "compiles": m.group(4),
+                    }
+                    events["ups"] += 1
+            if down_re.search(line):
+                with state_lock:
+                    events["downs"] += 1
+            if died_re.search(line):
+                with state_lock:
+                    events["died"] += 1
+            m = fleet_re.search(line)
+            if m:
+                fleet_url_box["url"] = m.group(1)
+                fleet_ready.set()
+
+    watcher = threading.Thread(
+        target=watch_stderr, name="fleet-stderr-watch", daemon=True
+    )
+    watcher.start()
+    if not fleet_ready.wait(args.timeout):
+        proc.kill()
+        raise SystemExit("timed out waiting for the fleet frontend")
+    fleet_url = fleet_url_box["url"]
+
+    def healthz():
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                fleet_url + "/healthz", timeout=10
+            ) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            # 503 mid-transition (a kill just landed): the body is
+            # still the router's health payload
+            return json.loads(e.read().decode("utf-8"))
+
+    # the pre-drill reference bits: every replica generation must answer
+    # these exact bytes for this exact probe
+    probe = np.random.RandomState(7).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    ref_bits = HttpTarget(fleet_url).submit(probe).result()
+    probed = set()
+    identity = {"ok": True}
+
+    def probe_new_members():
+        """Probe every not-yet-probed member directly (bit-identity
+        across all replicas that ever served)."""
+        with state_lock:
+            todo = {
+                i: m["url"] for i, m in members.items() if i not in probed
+            }
+        for i, url in todo.items():
+            try:
+                bits = HttpTarget(url).submit(probe).result()
+            except Exception as e:  # a member may die mid-probe (the kill)
+                print(
+                    f"==> [elastic] probe of replica {i} failed ({e}); "
+                    "skipping (already dead)", file=sys.stderr,
+                )
+                probed.add(i)
+                continue
+            if not np.array_equal(bits, ref_bits):
+                identity["ok"] = False
+            probed.add(i)
+            print(
+                f"==> [elastic] replica {i} bits "
+                f"{'match' if identity['ok'] else 'DIVERGE'}",
+                file=sys.stderr,
+            )
+
+    probe_new_members()  # the seed replica
+
+    def load_phase(tag, clients, duration_s, seed):
+        rep = run_load(
+            HttpTarget(fleet_url),
+            clients=clients,
+            requests_per_client=10**6,
+            images_max=4,
+            seed=seed,
+            duration_s=duration_s,
+        )
+        print(
+            f"==> [elastic] {tag}: {rep['requests']} reqs "
+            f"p99={rep['p99_ms']:.1f}ms hedged={rep['hedged']} "
+            f"failed={rep['failed']}", file=sys.stderr,
+        )
+        return rep
+
+    print("==> [elastic] phase 1: baseline (1 client)", file=sys.stderr)
+    steady = load_phase("baseline", 1, 5.0, seed=1)
+    held_at_min = int(healthz().get("healthy_replicas", -1)) == 1
+
+    print("==> [elastic] phase 2: 10x ramp + SIGKILL", file=sys.stderr)
+    ramp_result = {}
+    ramp_t = threading.Thread(
+        target=lambda: ramp_result.update(
+            load_phase("ramp", 10, 35.0, seed=2)
+        ),
+        name="ramp-load",
+    )
+    ramp_t.start()
+    # wait for the controller's scale-up under the ramp pressure
+    scaled_up = False
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if int(healthz().get("healthy_replicas", 0)) >= 2:
+            scaled_up = True
+            break
+        time.sleep(0.25)
+    probe_new_members()  # the scale-up replicas (warm, bit-identical)
+    kill_pid = None
+    if scaled_up:
+        with state_lock:
+            kill_pid = members[0]["pid"]  # the original seed replica
+        print(
+            f"==> [elastic] SIGKILL replica 0 (pid {kill_pid}) "
+            "under ramp load", file=sys.stderr,
+        )
+        os.kill(kill_pid, signal.SIGKILL)
+    ramp_t.join()
+    probe_new_members()  # any replacement spawned after the kill
+    ramp = ramp_result
+    healthy_after_ramp = int(healthz().get("healthy_replicas", -1))
+
+    print("==> [elastic] phase 3: ramp back (1 client)", file=sys.stderr)
+    settle = load_phase("settle", 1, 20.0, seed=3)
+    probe_new_members()
+    healthy_final = int(healthz().get("healthy_replicas", -1))
+
+    print("==> [elastic] phase 4: drain", file=sys.stderr)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=args.timeout)
+    watcher.join(timeout=10)
+    rec_run = None
+    for ln in out.splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                rec_run = json.loads(ln)
+            except ValueError:
+                continue
+    if rec_run is None:
+        raise SystemExit("fleet_run printed no JSON record")
+
+    with state_lock:
+        ledger = dict(members)
+        ups, downs, died = events["ups"], events["downs"], events["died"]
+    scaleup_compiles = [
+        m["compiles"] for i, m in ledger.items() if i >= 1
+    ]
+    p99_budget_ms = max(2.0 * steady["p99_ms"], steady["p99_ms"] + 25.0)
+    total_failed = steady["failed"] + ramp["failed"] + settle["failed"]
+    ok = (
+        proc.returncode == 0
+        and held_at_min
+        and scaled_up
+        and kill_pid is not None
+        and identity["ok"]
+        and steady["requests"] > 0
+        and ramp["requests"] > 0
+        and settle["requests"] > 0
+        and total_failed == 0  # zero client-visible errors, all phases
+        # p99 bounded: the ramp by the request deadline (queueing under
+        # 10x load is legitimate until capacity arrives), the settled
+        # fleet back within the steady-state budget
+        and ramp["p99_ms"] <= 4000.0
+        and settle["p99_ms"] <= p99_budget_ms
+        and all(c == "0" for c in scaleup_compiles)  # warm joins only
+        and rec_run["scale_ups"] >= 2  # ramp growth + post-kill refill
+        and rec_run["scale_downs"] >= 1  # the ramp-back shed
+        and rec_run["replica_failures"] >= 1  # the SIGKILL was seen
+        and healthy_final >= 1
+        and all(
+            rc in (0, None) for rc in rec_run["replica_rcs"].values()
+        )
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "elastic",
+        "match": ok,
+        "min_replicas": 1,
+        "max_replicas": 3,
+        "held_at_min_baseline": held_at_min,
+        "scaled_up_under_ramp": scaled_up,
+        "bit_identical_all_generations": identity["ok"],
+        "replicas_ever_served": len(ledger),
+        "scaleup_compiles": scaleup_compiles,
+        "scale_ups": rec_run["scale_ups"],
+        "scale_downs": rec_run["scale_downs"],
+        "replica_failures": rec_run["replica_failures"],
+        "stderr_ups": ups,
+        "stderr_downs": downs,
+        "stderr_died": died,
+        "requests": steady["requests"] + ramp["requests"]
+        + settle["requests"],
+        "failed": total_failed,
+        "hedged_during_ramp": ramp["hedged"],
+        "p99_steady_ms": round(steady["p99_ms"], 2),
+        "p99_ramp_ms": round(ramp["p99_ms"], 2),
+        "p99_settle_ms": round(settle["p99_ms"], 2),
+        "p99_budget_ms": round(p99_budget_ms, 2),
+        "healthy_after_ramp": healthy_after_ramp,
+        "healthy_final": healthy_final,
+        "spawn_ms_p50": rec_run["spawn_ms_p50"],
+        "drain_ms_p50": rec_run["drain_ms_p50"],
+        "fleet_rc": proc.returncode,
     }
 
 
@@ -1598,7 +1913,7 @@ def main() -> int:
         "--mode",
         choices=(
             "sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt",
-            "router", "canary", "zoo", "mesh",
+            "router", "canary", "zoo", "mesh", "elastic",
         ),
         default="sigterm",
     )
@@ -1644,7 +1959,9 @@ def main() -> int:
 
     work = args.out or tempfile.mkdtemp(prefix=f"chaos_{args.mode}_")
 
-    if args.mode in ("serve", "ckpt", "router", "canary", "zoo", "mesh"):
+    if args.mode in (
+        "serve", "ckpt", "router", "canary", "zoo", "mesh", "elastic",
+    ):
         record = {
             "serve": serve_drill,
             "ckpt": ckpt_drill,
@@ -1652,6 +1969,7 @@ def main() -> int:
             "canary": canary_drill,
             "zoo": zoo_drill,
             "mesh": mesh_drill,
+            "elastic": elastic_drill,
         }[args.mode](args, work)
         print(json.dumps(record))
         if record["match"] and not args.out:

@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""Elastic fleet launcher: replicas + router + autoscaling controller.
+
+The supervised process tree ROADMAP item 3 asks for, in one command
+(SERVING.md "Elastic fleet"):
+
+- spawns ``--replicas`` seed replicas (``serve.py --http_port 0``
+  processes; the first one populates the shared ``--aot_cache`` so every
+  later replica — seed or scale-up — joins with ``compile_count == 0``),
+- starts a :class:`~pytorch_cifar_tpu.serve.router.Router` + the SAME
+  HTTP frontend in front of it (clients cannot tell an elastic fleet
+  from a fixed one), and
+- hands replica lifecycle authority to a
+  :class:`~pytorch_cifar_tpu.serve.fleet.FleetController`: it scrapes
+  the fleet's own ``/healthz`` + ``/metrics``, scales up on sustained
+  queue/deadline/p99 pressure, scales down only when a drain costs
+  nothing, replaces dead replicas to the ``--min_replicas`` floor, and
+  never exceeds ``--max_replicas``.
+
+Then either drives the built-in closed-loop HTTP load generator
+(``--clients > 0``) or serves until SIGTERM/SIGINT (the chaos drill's
+mode: it ramps external load 10x and SIGKILLs replicas out from under
+the controller). Prints ONE JSON record on stdout; progress and the
+machine-parseable topology lines go to stderr:
+
+    ==> fleet: replica 0 pid=123 url=http://127.0.0.1:41001 compiles=3
+    ==> fleet: serving on http://127.0.0.1:41000
+    ==> fleet: scale-up replica 2 url=... pid=... compiles=0 (load ...)
+    ==> fleet: scale-down replica 2 url=... drain_s=0.21
+
+Usage:
+  python tools/fleet_run.py --ckpt ./checkpoint --model LeNet \
+      --min_replicas 1 --max_replicas 3 --aot_cache /tmp/aot
+  python tools/fleet_run.py --ckpt ./checkpoint --model LeNet \
+      --clients 8 --requests 64        # built-in load, then drain
+
+This driver never initializes a jax backend — replicas own the devices;
+this process moves bytes and decisions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--model", default="ResNet18")
+    p.add_argument(
+        "--replicas", type=int, default=0,
+        help="seed replica count (0 = min_replicas)",
+    )
+    p.add_argument("--min_replicas", type=int, default=1)
+    p.add_argument("--max_replicas", type=int, default=3)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port", type=int, default=0,
+        help="fleet HTTP port (0 = ephemeral; the URL prints on stderr)",
+    )
+    p.add_argument("--buckets", type=int, nargs="+", default=[1, 8, 32])
+    p.add_argument("--max_wait_ms", type=float, default=2.0)
+    p.add_argument("--deadline_ms", type=float, default=0.0)
+    p.add_argument("--replica_devices", type=int, default=1)
+    p.add_argument(
+        "--aot_cache", required=True,
+        help="shared AOT executable cache dir: replica 0 populates it; "
+        "every later replica (incl. every controller scale-up) joins "
+        "with compile_count == 0 — what makes scale-out cheap",
+    )
+    # policy knobs (serve/fleet.FleetPolicy; SERVING.md has the guidance)
+    p.add_argument("--queue_high", type=float, default=8.0)
+    p.add_argument("--queue_low", type=float, default=1.0)
+    p.add_argument("--p99_high_ms", type=float, default=0.0)
+    p.add_argument("--up_after_s", type=float, default=2.0)
+    p.add_argument("--down_after_s", type=float, default=10.0)
+    p.add_argument("--up_cooldown_s", type=float, default=5.0)
+    p.add_argument("--down_cooldown_s", type=float, default=20.0)
+    p.add_argument(
+        "--control_interval_s", type=float, default=0.5,
+        help="controller sweep period (scrape -> evaluate -> actuate)",
+    )
+    p.add_argument("--probe_s", type=float, default=0.5)
+    p.add_argument("--fail_after", type=int, default=2)
+    # built-in HTTP loadgen (0 clients = serve until SIGTERM/SIGINT)
+    p.add_argument("--clients", type=int, default=0)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--images_max", type=int, default=8)
+    p.add_argument("--duration_s", type=float, default=0.0)
+    p.add_argument("--bulk_fraction", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=900.0)
+    args = p.parse_args()
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve.fleet import (
+        FleetController,
+        FleetPolicy,
+        make_replica_launcher,
+        scrape_fleet,
+    )
+    from pytorch_cifar_tpu.serve.frontend import ServingFrontend
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+    from pytorch_cifar_tpu.serve.router import Router
+
+    policy = FleetPolicy(
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        queue_high=args.queue_high,
+        queue_low=args.queue_low,
+        p99_high_ms=args.p99_high_ms,
+        up_after_s=args.up_after_s,
+        down_after_s=args.down_after_s,
+        up_cooldown_s=args.up_cooldown_s,
+        down_cooldown_s=args.down_cooldown_s,
+    )
+    launcher = make_replica_launcher(
+        args.ckpt,
+        args.model,
+        aot_cache=args.aot_cache,
+        buckets=tuple(args.buckets),
+        deadline_ms=args.deadline_ms,
+        max_wait_ms=args.max_wait_ms,
+        num_devices=args.replica_devices,
+        host=args.host,
+        timeout_s=args.timeout,
+    )
+
+    # seed fleet: replica 0 alone first (it fills the AOT cache), then
+    # the rest — each joining warm
+    seeds = []
+    for i in range(max(args.replicas, args.min_replicas)):
+        replica = launcher(i)
+        seeds.append(replica)
+        print(
+            f"==> fleet: replica {i} pid={replica.pid} url={replica.url} "
+            f"compiles={replica.health.get('compiles')} "
+            f"aot_hits={replica.health.get('aot_cache_hits')}",
+            file=sys.stderr,
+        )
+
+    registry = MetricsRegistry()
+    router = Router(
+        [r.url for r in seeds],
+        registry=registry,
+        probe_s=args.probe_s,
+        fail_after=args.fail_after,
+    ).start()
+    frontend = ServingFrontend(
+        router, host=args.host, port=args.port, registry=registry
+    ).start()
+    print(f"==> fleet: serving on {frontend.url}", file=sys.stderr)
+
+    controller = FleetController(
+        router,
+        launcher,
+        policy,
+        scrape=lambda: scrape_fleet(frontend.url),
+        registry=registry,
+        interval_s=args.control_interval_s,
+    )
+    for replica in seeds:
+        controller.adopt(replica)
+    controller.start()
+    print(
+        f"==> fleet: controller up (min {policy.min_replicas}, max "
+        f"{policy.max_replicas}, band {policy.queue_low}-"
+        f"{policy.queue_high} queued/replica, up after "
+        f"{policy.up_after_s}s, down after {policy.down_after_s}s)",
+        file=sys.stderr,
+    )
+
+    report = {}
+    try:
+        if args.clients > 0:
+            target = HttpTarget(frontend.url)
+            report = run_load(
+                target,
+                clients=args.clients,
+                requests_per_client=args.requests,
+                images_max=args.images_max,
+                seed=args.seed,
+                duration_s=args.duration_s or None,
+                bulk_fraction=args.bulk_fraction,
+            )
+        else:
+            stop = threading.Event()
+            signal.signal(signal.SIGTERM, lambda *a: stop.set())
+            signal.signal(signal.SIGINT, lambda *a: stop.set())
+            stop.wait(args.duration_s or None)
+    finally:
+        print("==> fleet: draining", file=sys.stderr)
+        # controller first (no more actuation), then the edge, then the
+        # replica tree — every child reaped, no orphan survives this
+        # process (the subprocess-lifecycle invariant, now also checked
+        # statically by graftcheck)
+        controller.stop(drain_replicas=False)
+        frontend.stop()
+        router.stop()
+        replicas = controller.replicas()
+        replica_rcs = {}
+        for url, handle in replicas.items():
+            handle.decommission(timeout_s=60.0)
+            replica_rcs[url] = handle.proc.returncode
+
+    s = registry.summary()
+    record = {
+        "harness": "fleet_run",
+        "model": args.model,
+        "min_replicas": policy.min_replicas,
+        "max_replicas": policy.max_replicas,
+        "fleet_url": frontend.url,
+        "replicas_final": len(replicas),
+        "replica_rcs": replica_rcs,
+        "scale_ups": controller.stats["scale_ups"],
+        "scale_downs": controller.stats["scale_downs"],
+        "replica_failures": controller.stats["replica_failures"],
+        "scrape_errors": controller.stats["scrape_errors"],
+        "spawn_ms_p50": round(s.get("serve.fleet.spawn_ms.p50", 0.0), 1),
+        "drain_ms_p50": round(s.get("serve.fleet.drain_ms.p50", 0.0), 1),
+        **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in report.items()
+        },
+        "router": router.stats,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

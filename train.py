@@ -14,12 +14,45 @@ from pytorch_cifar_tpu.config import parse_config
 
 def main(argv=None) -> float:
     honor_platform_env()
+    config = parse_config(argv)
+    if config.elastic_procs > 0:
+        # elastic supervisor mode (train/elastic.py; ROADMAP item 3):
+        # this process spawns and supervises N train.py ranks — a
+        # preempted or added host becomes a terminate -> relaunch-at-
+        # new-world-size -> --resume cycle from the last durable
+        # checkpoint. The supervisor itself never touches a jax backend.
+        from pytorch_cifar_tpu.train.elastic import run_supervisor
+
+        raise SystemExit(run_supervisor(config, argv))
     enable_compilation_cache()
     from pytorch_cifar_tpu.train.trainer import Trainer
 
-    config = parse_config(argv)
     trainer = Trainer(config)  # installs the rank-aware logger
-    best = trainer.fit()
+    try:
+        best = trainer.fit()
+    except Exception:
+        if config.elastic:
+            import jax
+
+            if jax.process_count() > 1:
+                # elastic rank contract (train/elastic.py): a mid-fit
+                # failure in a multi-process world — a dead peer's
+                # collective raising, most commonly — is a membership
+                # event, not a crash: exit ELASTIC_RC so the supervisor
+                # relaunches the surviving world with --resume from the
+                # last durable checkpoint.
+                import logging
+                import sys
+
+                from pytorch_cifar_tpu.train.elastic import ELASTIC_RC
+
+                logging.getLogger(__name__).exception(
+                    "elastic rank failed mid-fit; exiting %d for the "
+                    "supervisor to resume the surviving world",
+                    ELASTIC_RC,
+                )
+                sys.exit(ELASTIC_RC)
+        raise
     stats = trainer.fault_stats
     if stats["bad_steps"] or stats["rollbacks"]:
         # surfaced on the CLI, not only in the log: a run that survived
