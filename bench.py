@@ -934,6 +934,145 @@ def run_serve_http(model: str, batch: int, steps: int, compute_dtype) -> dict:
     return report
 
 
+def run_serve_edge(model: str, batch: int, steps: int, compute_dtype) -> dict:
+    """``--serve-edge``: the connection-scaling A/B (SERVING.md
+    "Event-loop edge"). The SAME engine + micro-batcher serve the SAME
+    open-loop async client sweep behind BOTH edges — the threaded
+    frontend (one handler thread per connection) and the selectors
+    event loop (single loop thread + a small worker pool) — at each
+    connection count in ``connections``, on both wire encodings. All
+    cells are driven by ``loadgen.run_async_load`` (one driver thread
+    regardless of N), so the client side never thread-limits the sweep.
+    ``value`` is the EVENT edge's binary-wire img/s at the top
+    (drill) connection count; ``event_vs_threaded`` is the headline
+    ratio at that concurrency, ``scaling`` carries the full grid, and
+    ``http_vs_inproc`` re-measures the network-path tax against the
+    same batcher (both honest either way — the 1-core container makes
+    the event loop's win a connection-COUNT story, not a throughput
+    one)."""
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.parallel import make_mesh
+    from pytorch_cifar_tpu.serve import (
+        BatcherBackend,
+        EdgeFrontend,
+        InferenceEngine,
+        MicroBatcher,
+        ServingFrontend,
+    )
+    from pytorch_cifar_tpu.serve.loadgen import run_async_load, run_load
+
+    mesh = make_mesh()
+    n_devices = int(mesh.devices.size)
+    if n_devices == 1:
+        mesh = None  # exact single-chip engine path
+    max_b = min(128, batch)
+    buckets = tuple(sorted({b for b in (1, 8, 32, max_b) if b <= max_b}))
+    registry = MetricsRegistry()
+    engine = InferenceEngine.from_random(
+        model,
+        buckets=buckets,
+        compute_dtype=compute_dtype,
+        mesh=mesh,
+        registry=registry,
+    )
+    batcher = MicroBatcher(
+        engine,
+        max_batch=max_b,
+        max_wait_ms=2.0,
+        max_queue=64 * max_b,
+        registry=registry,
+    )
+    backend = BatcherBackend(engine, batcher)
+    connections = (4, 32, 128)
+    requests = max(steps, 2)
+    scaling = {}
+    edge_registries = {}
+    try:
+        inproc = run_load(
+            batcher, clients=8, requests_per_client=requests,
+            images_max=8, seed=0,
+        )
+        for edge, cls in (
+            ("threaded", ServingFrontend), ("event", EdgeFrontend),
+        ):
+            edge_registry = MetricsRegistry()
+            edge_registries[edge] = edge_registry
+            frontend = cls(backend, registry=edge_registry).start()
+            try:
+                run_async_load(  # warmup: page executables per edge
+                    frontend.url, clients=2, requests_per_client=2,
+                    wire="binary", seed=1,
+                )
+                scaling[edge] = {}
+                for wire in ("json", "binary"):
+                    cells = []
+                    for conns in connections:
+                        # equal offered load per cell: the sweep varies
+                        # CONCURRENCY, not total work
+                        per_client = max(
+                            2, requests * connections[0] // conns
+                        )
+                        rep = run_async_load(
+                            frontend.url,
+                            clients=conns,
+                            requests_per_client=per_client,
+                            images_max=8,
+                            wire=wire,
+                            seed=0,
+                        )
+                        cells.append({
+                            "connections": conns,
+                            "img_per_sec": round(rep["img_per_sec"], 3),
+                            "p50_ms": round(rep["p50_ms"], 3),
+                            "p99_ms": round(rep["p99_ms"], 3),
+                            "requests": rep["requests"],
+                            "rejected": rep["rejected"],
+                            "failed": rep["failed"],
+                        })
+                    scaling[edge][wire] = cells
+            finally:
+                frontend.stop()
+    finally:
+        batcher.close()
+    assert engine.compile_count == len(engine.buckets), (
+        "serve-edge bench recompiled after warmup"
+    )
+    # headline cell: the event edge, binary wire, drill concurrency
+    top = scaling["event"]["binary"][-1]
+    peer = scaling["threaded"]["binary"][-1]
+    report = dict(top)
+    report["img_per_sec"] = top["img_per_sec"]
+    report["max_batch"] = max_b
+    report["n_devices"] = n_devices
+    report["connections"] = list(connections)
+    report["scaling"] = scaling
+    report["event_vs_threaded"] = round(
+        top["img_per_sec"] / max(peer["img_per_sec"], 1e-9), 4
+    )
+    report["inproc_img_per_sec"] = round(inproc["img_per_sec"], 3)
+    report["http_vs_inproc"] = round(
+        top["img_per_sec"] / max(inproc["img_per_sec"], 1e-9), 4
+    )
+    s = edge_registries["event"].summary()
+    report["obs"] = {
+        # the event edge's own counters over its whole sweep: every
+        # accept accounted for, no protection tripped on a healthy run
+        "edge_accepts": s.get("serve.edge.accepts", 0.0),
+        "edge_closes": s.get("serve.edge.closes", 0.0),
+        "edge_rate_limited": s.get("serve.edge.rate_limited", 0.0),
+        "edge_loris_closed": s.get("serve.edge.loris_closed", 0.0),
+        "edge_shed": s.get("serve.edge.shed", 0.0),
+        "edge_read_p95_ms": round(s.get("serve.edge.read_ms.p95", 0.0), 3),
+        "edge_write_p95_ms": round(
+            s.get("serve.edge.write_ms.p95", 0.0), 3
+        ),
+        "http_requests": s.get("serve.http_requests", 0.0),
+        "http_errors": s.get("serve.http_errors", 0.0),
+        "wire_requests": s.get("serve.wire_requests", 0.0),
+    }
+    return report
+
+
 def run_serve_zoo(models, steps, compute_dtype) -> dict:
     """The multi-tenant zoo serving contract (SERVING.md "Multi-tenant
     zoo serving"): one ModelZooServer under a heavy-tailed per-model
@@ -1674,6 +1813,15 @@ def main() -> int:
         "p50/p95/p99 + img/s + http_vs_inproc in the single-line record",
     )
     parser.add_argument(
+        "--serve-edge", action="store_true", dest="serve_edge",
+        help="measure the event-loop edge (serve/edge.py, SERVING.md "
+        "'Event-loop edge'): a connection-scaling sweep driven by the "
+        "single-thread async load generator — threaded vs event "
+        "frontend at each connection count, both wire encodings — "
+        "with event_vs_threaded at drill concurrency and a re-measured "
+        "http_vs_inproc in the single-line record",
+    )
+    parser.add_argument(
         "--serve-mesh", action="store_true", dest="serve_mesh",
         help="measure cross-host serving (serve/mesh_replica.py, "
         "SERVING.md 'Multi-process mesh replica'): a 2-process logical "
@@ -1751,6 +1899,7 @@ def main() -> int:
         or args.step
         or args.serve
         or args.serve_http
+        or args.serve_edge
         or args.serve_zoo
         or args.ckpt
         or args.canary
@@ -1844,6 +1993,31 @@ def main() -> int:
             obs=report["obs"],
         )
         name = f"serve_http_{args.model}_b{report['max_batch']}"
+    elif args.serve_edge:
+        report = run_serve_edge(
+            args.model, args.batch, args.steps, compute_dtype
+        )
+        value = report["img_per_sec"]
+        # TOTAL img/s through the event edge's binary wire at the top
+        # (drill) connection count; the full grid rides along
+        unit = "images/sec"
+        extra = dict(
+            p50_ms=report["p50_ms"],
+            p99_ms=report["p99_ms"],
+            requests=report["requests"],
+            rejected=report["rejected"],
+            failed=report["failed"],
+            n_devices=report["n_devices"],
+            connections=report["connections"],
+            # the connection-scaling grid: edge x wire x conns cells
+            scaling=report["scaling"],
+            # the headline A/Bs at drill concurrency
+            event_vs_threaded=report["event_vs_threaded"],
+            inproc_img_per_sec=report["inproc_img_per_sec"],
+            http_vs_inproc=report["http_vs_inproc"],
+            obs=report["obs"],
+        )
+        name = f"serve_edge_{args.model}_b{report['max_batch']}"
     elif args.serve_zoo:
         zoo_models = [m.strip() for m in args.models.split(",") if m.strip()]
         report = run_serve_zoo(zoo_models, args.steps, compute_dtype)

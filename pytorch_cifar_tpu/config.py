@@ -357,6 +357,12 @@ class ServeConfig:
     # tests parse it); -1 keeps the PR 1-7 in-process loadgen behavior.
     http_port: int = -1
     http_host: str = "127.0.0.1"
+    # which edge serves the port (SERVING.md "Event-loop edge"):
+    # "threaded" = thread-per-connection http.server (the PR 8 frontend,
+    # simplest to debug); "event" = the non-blocking selectors loop
+    # (serve/edge.py) that holds 10k+ keep-alive connections on
+    # single-digit threads. Responses are bit-identical either way.
+    edge: str = "threaded"
 
     # observability (OBSERVABILITY.md): host-span trace file, periodic
     # JSONL metrics (queue depth, batch occupancy, admission-to-completion

@@ -20,6 +20,11 @@ each injection point is a read of an inert registry that tests and
   canary pipeline's output-level vetting (``serve/canary.py``) catches
   this one. :func:`regress_checkpoint` is the offline equivalent for an
   already-published file (``nan=True`` poisons instead of perturbing).
+- :func:`slow_loris` / :func:`conn_flood`: live network attackers for
+  the edge chaos drill (``tools/chaos_run.py --mode edge``) — a
+  one-byte-per-interval request trickle and a hold-open connection
+  flood, the two resource-exhaustion shapes the event-loop edge's read
+  deadlines exist to bound (SERVING.md "Event-loop edge").
 
 Arming works two ways:
 
@@ -235,3 +240,95 @@ def bitflip_file(path: str, offset: Optional[int] = None) -> int:
         f.seek(off)
         f.write(bytes([b[0] ^ 0x40]))
     return off
+
+
+def slow_loris(
+    host: str,
+    port: int,
+    *,
+    duration_s: float = 5.0,
+    interval_s: float = 0.5,
+    connect_timeout_s: float = 5.0,
+) -> Dict[str, int]:
+    """A slow-loris attacker against one HTTP edge: open a connection,
+    trickle ONE header byte per ``interval_s``, and never finish the
+    request. Against a per-connection-thread frontend this parks a
+    handler thread for the socket timeout; against the event-loop edge
+    (``serve/edge.py``) the per-connection read deadline must close it
+    long before ``duration_s`` elapses. Returns
+    ``{"sent": bytes trickled, "closed_by_server": 0/1}`` — the chaos
+    drill asserts ``closed_by_server == 1`` and the drill's foreground
+    traffic unaffected (ROBUSTNESS.md "edge drill")."""
+    import socket
+    import time
+
+    head = b"POST /predict HTTP/1.1\r\nContent-Length: 10\r\nX-Slow: "
+    sent = 0
+    closed = 0
+    sock = socket.create_connection((host, port), timeout=connect_timeout_s)
+    try:
+        sock.settimeout(interval_s)
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            try:
+                sock.sendall(head[sent % len(head):][:1])
+                sent += 1
+            except OSError:
+                closed = 1  # server reset us mid-trickle: the deadline
+                break
+            # a server-side close surfaces as EOF on the read side well
+            # before the send buffer notices
+            try:
+                if sock.recv(256) == b"":
+                    closed = 1
+                    break
+            except socket.timeout:
+                pass
+            except OSError:
+                closed = 1
+                break
+    finally:
+        sock.close()
+    return {"sent": sent, "closed_by_server": closed}
+
+
+def conn_flood(
+    host: str,
+    port: int,
+    *,
+    connections: int = 256,
+    hold_s: float = 1.0,
+    connect_timeout_s: float = 5.0,
+) -> Dict[str, int]:
+    """A connection flood against one HTTP edge: open ``connections``
+    sockets as fast as the listener accepts them, send NOTHING, hold
+    them ``hold_s``, then close. A thread-per-connection frontend burns
+    a thread per socket; the event-loop edge absorbs the whole flood on
+    one loop thread (an idle registered socket costs one fd and one
+    dict entry — deliberately NOT a loris deadline, since idle
+    keep-alive between requests is the legitimate client shape) and
+    reaps each on the attacker's close, with foreground traffic
+    undisturbed throughout. Returns ``{"opened": n, "refused": n}``."""
+    import socket
+    import time
+
+    socks = []
+    refused = 0
+    try:
+        for _ in range(connections):
+            try:
+                socks.append(
+                    socket.create_connection(
+                        (host, port), timeout=connect_timeout_s
+                    )
+                )
+            except OSError:
+                refused += 1
+        time.sleep(hold_s)
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+    return {"opened": len(socks), "refused": refused}

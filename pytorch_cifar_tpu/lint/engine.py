@@ -212,6 +212,9 @@ class _Project:
     def thread_reachable(self, path: str):
         return self.graph().thread_reachable_for(path)
 
+    def loop_callback_reachable(self, path: str):
+        return self.graph().loop_callback_reachable_for(path)
+
     def sanction_issues(self, path: str):
         return self.graph().sanction_issues_for(path)
 

@@ -500,6 +500,7 @@ class ProjectGraph:
         self._edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
         self._node_of: Dict[Tuple[str, str], ast.AST] = {}
         self._thread_entries: List[Tuple[str, str, str]] = []
+        self._loop_entries: List[Tuple[str, str, str]] = []
         self._sanctioned: Dict[Tuple[str, str], str] = {}
         self._sanction_issues: Dict[str, List[Tuple[ast.AST, str]]] = {}
         self._tracer_wrapper_cache: Dict[int, bool] = {}
@@ -705,6 +706,42 @@ class ProjectGraph:
         for node in ast.walk(m.tree):
             for child in ast.iter_child_nodes(node):
                 parents[child] = node
+        # event-loop callback entries (blocking-in-event-loop): in a
+        # module that imports ``selectors``, any function passed as the
+        # data argument of ``<selector>.register(fileobj, events, cb)``
+        # or ``.modify(...)`` is dispatched from the loop thread — the
+        # repo's loop convention (serve/edge.py) registers the callback
+        # AS the key data precisely so this resolution is static
+        imports_selectors = any(
+            (isinstance(n, ast.Import)
+             and any(a.name == "selectors" for a in n.names))
+            or (isinstance(n, ast.ImportFrom) and n.module == "selectors")
+            for n in ast.walk(m.tree)
+        )
+        if imports_selectors:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qualname(node.func)
+                if q is None or q.rsplit(".", 1)[-1] not in (
+                    "register", "modify",
+                ) or "." not in q:
+                    continue
+                data_arg = None
+                if len(node.args) >= 3:
+                    data_arg = node.args[2]
+                for kw in node.keywords:
+                    if kw.arg == "data":
+                        data_arg = kw.value
+                if data_arg is None:
+                    continue
+                r = self._resolve_value(m, parents, node, data_arg)
+                if r is not None and r[0] == "def":
+                    m2, k2, d2 = r[1]
+                    self._node_of[(m2.path, k2)] = d2
+                    self._loop_entries.append(
+                        (m2.path, k2, f"{m.name}:{k2}")
+                    )
         # call-graph edges + thread entries + external-trace seeds
         for key, d in m.defs.items():
             nk = (m.path, key)
@@ -826,6 +863,27 @@ class ProjectGraph:
         return {
             self._node_of[nk]: label
             for nk, label in self._thread_reach.items()
+            if nk[0] == ap and nk in self._node_of
+        }
+
+    def loop_callback_reachable_for(self, path: str) -> Dict[ast.AST, str]:
+        """{def node in ``path``: loop-entry label} for every def
+        reachable from a selectors-callback registration anywhere in the
+        linted tree (the ``register``/``modify`` data argument — see
+        ``_analyze_module``). The blocking-in-event-loop rule flags
+        unbounded blocking calls inside these defs: one stalled callback
+        stalls EVERY connection the loop holds."""
+        self._analyze()
+        if getattr(self, "_loop_reach", None) is None:
+            reach: Dict[Tuple[str, str], str] = {}
+            for epath, ekey, label in self._loop_entries:
+                for nk in self._closure({(epath, ekey)}):
+                    reach.setdefault(nk, label)
+            self._loop_reach = reach
+        ap = os.path.abspath(path)
+        return {
+            self._node_of[nk]: label
+            for nk, label in self._loop_reach.items()
             if nk[0] == ap and nk in self._node_of
         }
 

@@ -32,6 +32,7 @@ import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pytorch_cifar_tpu.lint.engine import Finding, ModuleCtx
+from pytorch_cifar_tpu.lint.locks import _classify_blocking
 from pytorch_cifar_tpu.lint.project import (  # noqa: F401  (re-exported)
     HOST_COLLECTIVES,
     TRACER_CALLS,
@@ -2171,6 +2172,101 @@ class MetricNameDrift(Rule):
         return out
 
 
+# ---------------------------------------------------------------------
+# 18. blocking-in-event-loop
+# ---------------------------------------------------------------------
+
+# socket ops from locks._BLOCKING_ATTRS that stop blocking once the
+# module has put its sockets in non-blocking mode — exempted when ANY
+# `.setblocking(False)` call appears in the module (the event-loop edge
+# convention: every socket the loop touches is non-blocking, so these
+# return EWOULDBLOCK instead of stalling). Deliberately module-coarse:
+# per-object tracking would be flow analysis, and a selectors loop with
+# a BLOCKING socket is already broken before lint gets involved.
+_LOOP_SOCKET_ATTRS = frozenset({
+    "accept", "recv", "recvfrom", "sendall", "connect",
+})
+
+
+class BlockingInEventLoop(Rule):
+    name = "blocking-in-event-loop"
+    summary = (
+        "an unbounded blocking call (bare lock.acquire(), zero-arg "
+        "queue get()/join()/wait()/result(), time.sleep, subprocess "
+        "waits, jax.device_get, blocking socket/HTTP I/O) is reachable "
+        "from a selectors callback — a function registered as the data "
+        "of <selector>.register/.modify. The loop thread multiplexes "
+        "EVERY connection: one stalled callback stalls them all, which "
+        "is precisely the failure the event-loop edge exists to avoid. "
+        "Hand blocking work to a worker thread and re-arm the "
+        "completion through the wakeup pipe (serve/edge.py's "
+        "_worker/_on_wakeup shape). Socket ops are exempt in modules "
+        "that call .setblocking(False) — non-blocking sockets return "
+        "EWOULDBLOCK rather than stall"
+    )
+
+    @staticmethod
+    def _classify(node: ast.Call) -> Optional[str]:
+        q = qualname(node.func)
+        if q in ("time.sleep", "sleep"):
+            return "time.sleep() (stalls the loop for the full duration)"
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            n_args = len(node.args) + len(node.keywords)
+            if attr == "acquire" and not n_args:
+                # acquire(False) / acquire(timeout=...) are bounded;
+                # the bare call parks the loop behind whoever holds it
+                return "acquire() without a timeout"
+            if attr in ("wait", "result") and not n_args:
+                # Event.wait()/Condition.wait()/Future.result() with no
+                # bound — waits forever for a producer that may be a
+                # worker this very loop is supposed to keep feeding
+                return "%s() without a timeout" % attr
+        return _classify_blocking(node)
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        reach = ctx.project.loop_callback_reachable(ctx.path)
+        if not reach:
+            return []
+        nonblocking_sockets = any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "setblocking"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value is False
+            for node in ast.walk(ctx.tree)
+        )
+        out = []
+        for fn, entry in reach.items():
+            if not isinstance(fn, FuncNode):
+                continue
+            for node in walk_no_nested_funcs(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = self._classify(node)
+                if label is None:
+                    continue
+                if (
+                    nonblocking_sockets
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _LOOP_SOCKET_ATTRS
+                ):
+                    continue
+                out.append(
+                    self.finding(
+                        ctx, node,
+                        "%s is reachable from selectors callback %s — "
+                        "the loop thread holds every connection, so one "
+                        "stalled callback stalls them all; dispatch the "
+                        "blocking work to a worker thread and post the "
+                        "completion back through the wakeup pipe"
+                        % (label, entry),
+                    )
+                )
+        return out
+
+
 RULES = (
     JitImpurity(),
     PrngReuse(),
@@ -2189,6 +2285,7 @@ RULES = (
     CondWaitDiscipline(),
     LockLeak(),
     MetricNameDrift(),
+    BlockingInEventLoop(),
 )
 
 

@@ -33,6 +33,14 @@ becomes a long-lived prediction service:
   frontend — ``serve.py --http_port`` runs one replica,
   ``tools/router_run.py`` runs the fleet (SERVING.md "HTTP frontend &
   router").
+- :mod:`~pytorch_cifar_tpu.serve.edge` is the same edge rebuilt for
+  production connection counts (``--edge event``): a non-blocking
+  ``selectors`` event loop where single-digit threads hold 10k+
+  keep-alive connections, with per-client rate limiting, slow-loris
+  deadlines, header-only oversized rejection, and priority-aware load
+  shedding enforced before a request costs allocation; the router's
+  :class:`~pytorch_cifar_tpu.serve.edge.EdgePool` multiplexes replica
+  exchanges the same way (SERVING.md "Event-loop edge").
 - :mod:`~pytorch_cifar_tpu.serve.tenancy` is multi-tenant zoo serving:
   a :class:`~pytorch_cifar_tpu.serve.tenancy.ModelZooServer` hosts N
   registry models in one process — one engine + micro-batcher pair per
@@ -91,6 +99,10 @@ from pytorch_cifar_tpu.serve.fleet import (  # noqa: F401
     FleetController,
     FleetPolicy,
     FleetSignals,
+)
+from pytorch_cifar_tpu.serve.edge import (  # noqa: F401
+    EdgeFrontend,
+    EdgePool,
 )
 from pytorch_cifar_tpu.serve.frontend import (  # noqa: F401
     BatcherBackend,

@@ -84,7 +84,7 @@ class Replica:
     Router and only mutated under the router's lock; this class only
     owns the sockets."""
 
-    def __init__(self, url: str, *, timeout_s: float = 30.0):
+    def __init__(self, url: str, *, timeout_s: float = 30.0, pool=None):
         parts = urlsplit(url if "//" in url else f"http://{url}")
         if parts.scheme != "http" or not parts.hostname:
             raise ValueError(f"replica url must be http://host:port: {url!r}")
@@ -92,6 +92,11 @@ class Replica:
         self.host = parts.hostname
         self.tcp_port = int(parts.port or 80)
         self.timeout_s = float(timeout_s)
+        # event transport (serve/edge.EdgePool): when set, exchanges go
+        # through the shared non-blocking pool instead of a per-thread
+        # http.client connection — same (status, payload) contract, and
+        # the pool owns the stale-keep-alive retry
+        self._pool = pool
         self._local = threading.local()
         # dispatch state — mutated ONLY under Router._lock
         self.healthy = True
@@ -137,6 +142,28 @@ class Replica:
         (server idled it out) gets ONE transparent reconnect that
         resends the COMPLETE body — a binary frame is never replayed
         from a half-consumed stream."""
+        if self._pool is not None:
+            try:
+                status, payload = self._pool.exchange(
+                    self.host,
+                    self.tcp_port,
+                    method,
+                    path,
+                    body,
+                    content_type=content_type,
+                    timeout_s=(
+                        timeout_s if timeout_s is not None else self.timeout_s
+                    ),
+                )
+            except OSError as e:
+                raise ReplicaError(f"{self.url}: {e}") from None
+            if raw and status == 200:
+                return status, payload
+            try:
+                obj = json.loads(payload.decode("utf-8")) if payload else {}
+            except ValueError:
+                obj = {"error": payload[:200].decode("utf-8", "replace")}
+            return status, obj
         headers = {"Content-Type": content_type} if body else {}
         for attempt in (0, 1):
             conn = None
@@ -210,11 +237,27 @@ class Router:
         hedge: bool = True,
         request_timeout_s: float = 60.0,
         probe_timeout_s: float = 2.0,
+        transport: str = "threaded",
     ):
         if not replica_urls:
             raise ValueError("router needs at least one replica url")
+        if transport not in ("threaded", "event"):
+            raise ValueError(
+                f"transport must be 'threaded' or 'event', got {transport!r}"
+            )
+        self.transport = transport
+        # event transport: ONE shared non-blocking pool multiplexes every
+        # replica's in-flight exchanges (serve/edge.EdgePool) — dispatch,
+        # hedging, eviction, and status classification are unchanged, only
+        # the socket layer under Replica.request differs
+        self._pool = None
+        if transport == "event":
+            from pytorch_cifar_tpu.serve.edge import EdgePool
+
+            self._pool = EdgePool(timeout_s=request_timeout_s).start()
         self.replicas = [
-            Replica(u, timeout_s=request_timeout_s) for u in replica_urls
+            Replica(u, timeout_s=request_timeout_s, pool=self._pool)
+            for u in replica_urls
         ]
         self.probe_s = float(probe_s)
         self.fail_after = int(fail_after)
@@ -258,7 +301,9 @@ class Router:
         sweeps if that trust was misplaced). Re-adding a URL already in
         rotation returns the existing entry (idempotent: a controller
         retry must not double-register)."""
-        replica = Replica(url, timeout_s=self.request_timeout_s)
+        replica = Replica(
+            url, timeout_s=self.request_timeout_s, pool=self._pool
+        )
         with self._lock:
             for r in self.replicas:
                 if r.url == replica.url:
@@ -613,6 +658,7 @@ class Router:
     @property
     def stats(self) -> dict:
         return {
+            "transport": self.transport,
             "requests": int(self._c_requests.value),
             "images": int(self._c_images.value),
             "hedged": int(self._c_hedged.value),
@@ -653,6 +699,8 @@ class Router:
             t.join()
         for replica in self.replicas:
             replica.close()
+        if self._pool is not None:
+            self._pool.close()
 
     def __enter__(self):
         return self.start()

@@ -60,9 +60,20 @@ def _serve_http(cfg, backend, registry) -> dict:
     import time
 
     from pytorch_cifar_tpu.obs.metrics import _percentile_from_buckets
-    from pytorch_cifar_tpu.serve import ServingFrontend
 
-    frontend = ServingFrontend(
+    # --edge picks the I/O layer, nothing else: both frontends speak the
+    # same routes/encodings and emit the same serve.http_* metrics, so
+    # the report below is edge-agnostic (SERVING.md "Event-loop edge")
+    if cfg.edge == "event":
+        from pytorch_cifar_tpu.serve.edge import EdgeFrontend as _Frontend
+    elif cfg.edge == "threaded":
+        from pytorch_cifar_tpu.serve import ServingFrontend as _Frontend
+    else:
+        raise SystemExit(
+            f"--edge must be 'event' or 'threaded', got {cfg.edge!r}"
+        )
+
+    frontend = _Frontend(
         backend,
         host=cfg.http_host,
         port=cfg.http_port,

@@ -96,10 +96,13 @@ SLOW_TESTS = {
     ),
     # the mesh-replica bench A/B spawns five train/serve subprocesses
     # with a real 2-process gloo rendezvous (~3 min on 1 core); the
-    # elastic bench spawns two supervised fleet trees + a training run
+    # elastic bench spawns two supervised fleet trees + a training run;
+    # the edge bench sweeps both frontends to 128 connections — the
+    # threaded edge's collapse cell alone runs for ~a minute
     "test_bench.py": (
         "test_bench_serve_mesh_mode_prints_one_json_line",
         "test_bench_serve_elastic_mode_prints_one_json_line",
+        "test_bench_serve_edge_mode_prints_one_json_line",
     ),
 }
 

@@ -426,3 +426,53 @@ def test_bench_serve_http_mode_prints_one_json_line():
     # reported (the host half of the serve roofline)
     assert rec["obs"]["wire_requests"] > 0
     assert rec["obs"]["staging_reuse"] > 0
+
+
+def test_bench_serve_edge_mode_prints_one_json_line():
+    """--serve-edge (event-loop edge PR): the connection-scaling A/B —
+    the same engine+batcher behind the threaded frontend and the
+    selectors event loop, swept over connection counts on both wires by
+    the single-thread async load generator. `value` is the event edge's
+    binary-wire img/s at drill concurrency; the full grid and the
+    event_vs_threaded ratio ride the same single-line record. The
+    ratio's VALUE is a measurement, not a schema guarantee (1-core
+    jitter; BENCHMARKS.md records the honest numbers) — but the event
+    edge itself must hold a zero-failure drill cell."""
+    rec, _ = run_bench(
+        ["--model", "LeNet", "--serve-edge", "--steps", "2",
+         "--batch", "16"]
+    )
+    assert rec["unit"] == "images/sec"
+    assert rec["value"] > 0
+    assert rec["metric"].startswith("serve_edge_LeNet_b16"), rec
+    assert rec["p99_ms"] >= rec["p50_ms"] > 0
+    assert rec["connections"] == [4, 32, 128]
+    # the grid: edge x wire x connection-count, every cell schema-stable
+    for edge in ("threaded", "event"):
+        for wire in ("json", "binary"):
+            cells = rec["scaling"][edge][wire]
+            assert [c["connections"] for c in cells] == [4, 32, 128]
+            for c in cells:
+                assert c["requests"] > 0
+                assert c["p99_ms"] >= c["p50_ms"] > 0
+    # the event edge's headline cell is the record's value, and it holds
+    # the drill concurrency without dropping a single request (the
+    # threaded edge is allowed to collapse there — that is the point)
+    top = rec["scaling"]["event"]["binary"][-1]
+    # the record rounds value to 2 decimals; the cell keeps 3
+    assert rec["value"] == round(top["img_per_sec"], 2)
+    assert rec["failed"] == 0 and rec["rejected"] == 0
+    for wire in ("json", "binary"):
+        for c in rec["scaling"]["event"][wire]:
+            assert c["failed"] == 0
+    assert rec["event_vs_threaded"] > 0
+    assert rec["inproc_img_per_sec"] > 0 and rec["http_vs_inproc"] > 0
+    # the edge's own accounting balanced over the sweep: every accepted
+    # connection closed, no protection tripped on a healthy local run
+    assert rec["obs"]["edge_accepts"] > 0
+    assert rec["obs"]["edge_closes"] == rec["obs"]["edge_accepts"]
+    assert rec["obs"]["edge_rate_limited"] == 0
+    assert rec["obs"]["edge_loris_closed"] == 0
+    assert rec["obs"]["edge_shed"] == 0
+    assert rec["obs"]["http_errors"] == 0
+    assert rec["obs"]["wire_requests"] > 0

@@ -281,3 +281,32 @@ def test_canary_drill_bad_checkpoints_contained_good_promotes(tmp_path):
     assert rec["failed"] == 0 and rec["requests"] > 0
     assert rec["bulk_requests"] > 0
     assert rec["pipeline_rc"] == 0
+
+
+def test_edge_drill_loris_flood_and_replica_kill(tmp_path):
+    """--mode edge (SERVING.md "Event-loop edge"): a 2-replica
+    ``--edge event`` fleet under sustained mixed-wire async load takes
+    the two resource-exhaustion attacks the edge's protections exist
+    for, then the router drill's replica SIGKILL. Asserted: a
+    slow-loris trickle is reset by the read deadline mid-trickle (the
+    attacker observes the close, pct_serve_edge_loris_closed ticks, the
+    foreground drops NOTHING); a 256-connection hold-open flood is
+    absorbed on the one loop thread with zero foreground failures and
+    zero refused connects; the SIGKILL loses a bounded handful and the
+    router evicts; /predict stays bit-identical across both replicas
+    and the router over BOTH wire encodings; SIGTERM drains rc 0."""
+    rec = run_chaos("edge", tmp_path, extra=("--epochs", "2"))
+    assert rec["match"] is True
+    assert rec["transport"] == "event"
+    assert rec["bit_identical"] is True
+    assert rec["requests"] > 0
+    assert rec["loris"]["closed_by_server"] == 1
+    assert rec["loris"]["sent"] > 0
+    assert rec["loris_closed_counter"] >= 1
+    assert rec["failed_during_loris"] == 0
+    assert rec["flood"]["opened"] >= 200
+    assert rec["flood"]["refused"] == 0
+    assert rec["failed_during_flood"] == 0
+    assert rec["failed_during_kill"] <= max(4, rec["requests"] // 20)
+    assert rec["evictions"] >= 1
+    assert rec["router_rc"] == 0

@@ -1,0 +1,564 @@
+"""Event-loop edge tests (CPU, fast, loopback-only — tier-1).
+
+The contracts pinned here are the ones SERVING.md "Event-loop edge"
+promises:
+- the EdgeFrontend answers BIT-identically to the threaded frontend
+  across both wire encodings, alone and behind a multi-replica router
+  on the event transport (EdgePool),
+- the per-connection state machine survives partial reads (a request
+  trickled at every interesting boundary) and partial writes,
+- keep-alive connections carry many sequential requests on ONE accept,
+- the edge protections fire from the cheapest possible position:
+  rate-limit 429 from the request head, slow-loris close at the read
+  deadline (idle keep-alive untouched), oversized rejection before the
+  body is read and mid-body from the 24 PCTW header bytes alone, and
+  priority-aware shedding before a worker is spent,
+- graceful drain leaves no edge thread and no leaked fd.
+
+The live-attack versions of these (real slow_loris/conn_flood attackers
+against a 2-replica fleet under load) are the chaos drill
+(tools/chaos_run.py --mode edge, test_chaos.py); this file is the
+in-process half the inner loop runs on every change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_cifar_tpu.obs import MetricsRegistry
+from pytorch_cifar_tpu.serve import wire
+from pytorch_cifar_tpu.serve.edge import EdgeFrontend, EdgePool
+from pytorch_cifar_tpu.serve.frontend import (
+    MAX_IMAGES_PER_REQUEST,
+    BatcherBackend,
+    ServingFrontend,
+)
+from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+from pytorch_cifar_tpu.serve.router import Router
+
+
+def _images(n, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+
+
+class StubBackend:
+    """Protocol-test backend: constant logits + call counting (same
+    shape as test_frontend's — the edge must make it unreachable on
+    every rejection path)."""
+
+    def __init__(self, tag=1.0):
+        self.tag = tag
+        self.engine_version = 1
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def predict(self, images, deadline_ms=None, priority="interactive"):
+        with self._lock:
+            self.calls += 1
+        out = np.zeros((images.shape[0], 10), np.float32)
+        out[:, 0] = self.tag
+        return out
+
+    def health(self):
+        return {"status": "ok", "role": "stub", "tag": self.tag}
+
+
+class GatedBackend(StubBackend):
+    """Blocks every predict on an event — builds a deterministic
+    dispatch backlog for the shed-tier test."""
+
+    def __init__(self):
+        super().__init__()
+        self.gate = threading.Event()
+
+    def predict(self, images, deadline_ms=None, priority="interactive"):
+        self.gate.wait(timeout=30)
+        return super().predict(images, deadline_ms, priority)
+
+
+@pytest.fixture(scope="module")
+def lenet_stack():
+    """One real engine + batcher shared by a threaded AND an event
+    frontend (module-scoped: one LeNet compile for the whole file) —
+    the A/B pair every bit-identity case compares."""
+    import jax.numpy as jnp
+
+    from pytorch_cifar_tpu.serve import InferenceEngine, MicroBatcher
+
+    engine = InferenceEngine.from_random(
+        "LeNet", buckets=(1, 4), compute_dtype=jnp.float32
+    )
+    batcher = MicroBatcher(engine, max_batch=4, max_wait_ms=1, max_queue=64)
+    backend = BatcherBackend(engine, batcher)
+    threaded = ServingFrontend(backend).start()
+    event = EdgeFrontend(backend).start()
+    yield engine, threaded, event
+    event.stop()
+    threaded.stop()
+    batcher.close()
+
+
+# -- bit-identity: the drop-in contract ---------------------------------
+
+
+def test_event_edge_bit_identical_to_threaded_both_wires(lenet_stack):
+    """The tentpole contract: the SAME request through the threaded and
+    the event frontend returns byte-equal logits on BOTH encodings, and
+    both equal an in-process engine.predict of the same rows."""
+    engine, threaded, event = lenet_stack
+    for n in (1, 3, 4):
+        x = _images(n, seed=n)
+        want = engine.predict(x)
+        for wire_mode in ("json", "binary"):
+            t_t = HttpTarget(threaded.url, wire=wire_mode)
+            t_e = HttpTarget(event.url, wire=wire_mode)
+            got_t = t_t.submit(x).result()
+            got_e = t_e.submit(x).result()
+            t_t.close()
+            t_e.close()
+            assert np.array_equal(got_e, want), (n, wire_mode)
+            assert np.array_equal(got_e, got_t), (n, wire_mode)
+            assert got_e.dtype == np.float32
+
+
+def test_event_edge_closed_loop_load_zero_failures(lenet_stack):
+    """A mixed-wire closed loop against the event edge finishes with
+    zero failures — and the serve.http_* family the report reads is
+    populated exactly like the threaded frontend's."""
+    _, _, event = lenet_stack
+    before = event.c_http_requests.value
+    target = HttpTarget(event.url, wire="mixed")
+    rep = run_load(
+        target, clients=4, requests_per_client=6, images_max=4, seed=9
+    )
+    target.close()
+    assert rep["failed"] == 0 and rep["requests"] == 24
+    assert event.c_http_requests.value >= before + 24
+    assert event.c_wire_requests.value > 0  # the binary half of "mixed"
+
+
+def test_event_router_multi_replica_bit_identical(lenet_stack):
+    """Two event replicas behind the router on the EVENT transport
+    (EdgePool): answers bit-identical to the engine through every path,
+    both wires, and both replicas actually serve."""
+    engine, _, event = lenet_stack
+    second = EdgeFrontend(event.backend).start()
+    try:
+        with Router([event.url, second.url], transport="event") as r:
+            assert r.transport == "event"
+            x = _images(3, seed=77)
+            want = engine.predict(x)
+            for _ in range(8):
+                assert np.array_equal(r.predict(x), want)
+            with EdgeFrontend(r) as edge_of_router:
+                for wire_mode in ("json", "binary"):
+                    t = HttpTarget(edge_of_router.url, wire=wire_mode)
+                    assert np.array_equal(t.submit(x).result(), want)
+                    t.close()
+            health = r.health()
+            assert health["healthy_replicas"] == 2
+    finally:
+        second.stop()
+
+
+def test_edge_pool_exchange_and_keep_alive_reuse():
+    """EdgePool (the router's transport) against an event frontend:
+    sequential exchanges ride ONE accepted connection (keep-alive at
+    the pool side too), and a healthz GET works through it."""
+    stub = StubBackend()
+    with EdgeFrontend(stub) as fe:
+        pool = EdgePool().start()
+        try:
+            body = json.dumps({"images": _images(1).tolist()}).encode()
+            for _ in range(5):
+                status, payload = pool.exchange(
+                    fe.host, fe.port, "POST", "/predict", body
+                )
+                assert status == 200
+                assert json.loads(payload)["logits"][0][0] == 1.0
+            status, payload = pool.exchange(
+                fe.host, fe.port, "GET", "/healthz"
+            )
+            assert status == 200
+            assert json.loads(payload)["status"] == "ok"
+        finally:
+            pool.close()
+        assert stub.calls == 5
+        assert int(fe.c_accepts.value) == 1  # every exchange reused it
+
+
+# -- the state machine: partial reads, partial writes, keep-alive -------
+
+
+def _recv_response(sock, timeout=30):
+    """Read exactly one HTTP/1.1 response off a raw socket (status,
+    headers dict, body bytes) without consuming past it."""
+    sock.settimeout(timeout)
+    buf = bytearray()
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        assert chunk, "server closed mid-head"
+        buf += chunk
+    head, _, rest = bytes(buf).partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", "0"))
+    body = bytearray(rest)
+    while len(body) < length:
+        chunk = sock.recv(4096)
+        assert chunk, "server closed mid-body"
+        body += chunk
+    assert len(body) == length, "read past the response"
+    return status, headers, bytes(body)
+
+
+def _binary_request(x, path="/predict"):
+    frame = wire.encode_request(x)
+    head = (
+        f"POST {path} HTTP/1.1\r\n"
+        f"Content-Type: {wire.CONTENT_TYPE}\r\n"
+        f"Content-Length: {len(frame)}\r\n"
+        "Connection: keep-alive\r\n\r\n"
+    ).encode()
+    return head, frame
+
+
+def test_partial_reads_resume_at_every_boundary(lenet_stack):
+    """A binary request trickled in adversarial splits — mid request
+    line, mid header, ON the CRLF2 boundary, mid PCTW header (before
+    the 24 bytes that allow the early n-check), exactly AT the PCTW
+    header, mid payload — must decode to the same bit-identical answer
+    as one clean send. Partial writes are exercised by the same
+    exchange: the response leaves through the memoryview queue."""
+    engine, _, event = lenet_stack
+    x = _images(3, seed=5)
+    want = engine.predict(x)
+    head, frame = _binary_request(x)
+    msg = head + frame
+    # split positions: every state-machine transition gets a cut on or
+    # next to it (head find, body start, wire-header check, completion)
+    hs = len(head)
+    cuts = sorted({
+        1, 5, hs - 2, hs, hs + 1,
+        hs + wire.HEADER_SIZE - 1, hs + wire.HEADER_SIZE,
+        hs + wire.HEADER_SIZE + 7, len(msg) - 1,
+    })
+    for cut in cuts:
+        with socket.create_connection((event.host, event.port)) as s:
+            s.sendall(msg[:cut])
+            time.sleep(0.05)  # let the loop consume the first fragment
+            s.sendall(msg[cut:])
+            status, _, body = _recv_response(s)
+        assert status == 200, cut
+        logits, version = wire.decode_response(body)
+        assert np.array_equal(logits, want), cut
+
+
+def test_keep_alive_many_requests_one_accept(lenet_stack):
+    """One raw connection carries JSON and binary requests back to back
+    (keep-alive), including two PIPELINED requests sent in one write —
+    all answered in order, all on a single accept."""
+    engine, _, event = lenet_stack
+    accepts_before = int(event.c_accepts.value)
+    x = _images(2, seed=11)
+    want = engine.predict(x)
+    jbody = json.dumps({"images": x.tolist()}).encode()
+    jreq = (
+        f"POST /predict HTTP/1.1\r\nContent-Type: application/json\r\n"
+        f"Content-Length: {len(jbody)}\r\n\r\n"
+    ).encode() + jbody
+    bhead, bframe = _binary_request(x)
+    with socket.create_connection((event.host, event.port)) as s:
+        for _ in range(3):  # alternate encodings on one connection
+            s.sendall(jreq)
+            status, _, body = _recv_response(s)
+            assert status == 200
+            got = np.array(json.loads(body)["logits"], np.float32)
+            assert np.array_equal(got, want)
+            s.sendall(bhead + bframe)
+            status, _, body = _recv_response(s)
+            assert status == 200
+            assert np.array_equal(wire.decode_response(body)[0], want)
+        # pipelined: two requests in ONE send; the parser must buffer
+        # the second while the first is in flight and answer both
+        s.sendall(jreq + jreq)
+        for _ in range(2):
+            status, _, body = _recv_response(s)
+            assert status == 200
+            got = np.array(json.loads(body)["logits"], np.float32)
+            assert np.array_equal(got, want)
+    assert int(event.c_accepts.value) == accepts_before + 1
+
+
+# -- edge protections ---------------------------------------------------
+
+
+def test_rate_limit_429_from_the_head():
+    """Over-budget requests are 429'd from the request head alone: the
+    backend never sees them, the rate_limited counter ticks, and the
+    connection closes after the 429 (the unread body must not be parsed
+    as the next request)."""
+    stub = StubBackend()
+    fe = EdgeFrontend(stub, rate_limit_rps=0.001, rate_burst=2).start()
+    try:
+        target = HttpTarget(fe.url, wire="json")
+        assert target.submit(_images(1)).result() is not None
+        target.close()
+        target = HttpTarget(fe.url, wire="json")
+        assert target.submit(_images(1)).result() is not None
+        target.close()
+        # burst of 2 spent; the third must be refused from the head
+        body = json.dumps({"images": _images(1).tolist()}).encode()
+        with socket.create_connection((fe.host, fe.port)) as s:
+            s.sendall(
+                (
+                    "POST /predict HTTP/1.1\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n"
+                ).encode()
+            )  # head only — a 429 must not wait for the body
+            status, headers, payload = _recv_response(s)
+            assert status == 429
+            assert "rate limit" in json.loads(payload)["error"]
+            assert headers["connection"] == "close"
+            s.settimeout(5)
+            assert s.recv(256) == b""  # server closed after the flush
+        assert int(fe.c_rate_limited.value) == 1
+        assert stub.calls == 2
+    finally:
+        fe.stop()
+
+
+def test_slow_loris_closed_at_deadline_idle_keep_alive_untouched():
+    """A connection that STARTS a request and trickles is closed at
+    read_deadline_s and counted loris_closed; an IDLE keep-alive
+    connection (zero bytes sent) lives on — idle is the legitimate
+    client shape between requests."""
+    stub = StubBackend()
+    fe = EdgeFrontend(stub, read_deadline_s=0.4).start()
+    try:
+        idle = socket.create_connection((fe.host, fe.port))
+        loris = socket.create_connection((fe.host, fe.port))
+        loris.sendall(b"POST /predict HTTP/1.1\r\nContent-Le")
+        loris.settimeout(5)
+        assert loris.recv(256) == b""  # deadline reset, well before 5 s
+        loris.close()
+        assert int(fe.c_loris_closed.value) == 1
+        # the idle connection must still answer a real request
+        body = json.dumps({"images": _images(1).tolist()}).encode()
+        idle.sendall(
+            (
+                "POST /predict HTTP/1.1\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n"
+            ).encode() + body
+        )
+        status, _, _ = _recv_response(idle)
+        assert status == 200
+        idle.close()
+        assert int(fe.c_loris_closed.value) == 1  # idle never counted
+    finally:
+        fe.stop()
+
+
+def test_oversized_rejected_before_body_and_mid_body():
+    """Oversized requests die as early as structurally possible: a
+    binary Content-Length beyond the frame cap is 400'd from the HEAD
+    (no body byte sent); a legal-length frame whose PCTW header claims
+    n > MAX_IMAGES_PER_REQUEST is 400'd the moment the 24 header bytes
+    arrive, mid-body. The backend sees neither."""
+    stub = StubBackend()
+    fe = EdgeFrontend(stub).start()
+    try:
+        cap = wire.max_request_bytes(
+            fe.image_shape, MAX_IMAGES_PER_REQUEST
+        )
+        with socket.create_connection((fe.host, fe.port)) as s:
+            s.sendall(
+                (
+                    "POST /predict HTTP/1.1\r\n"
+                    f"Content-Type: {wire.CONTENT_TYPE}\r\n"
+                    f"Content-Length: {cap + 1}\r\n\r\n"
+                ).encode()
+            )  # head only: the 400 must not wait for cap+1 bytes
+            status, _, payload = _recv_response(s)
+            assert status == 400
+            assert "exceeds" in json.loads(payload)["error"]
+        # mid-body: an in-cap Content-Length hiding an oversized n
+        bad_n = MAX_IMAGES_PER_REQUEST + 1
+        hdr = wire._HEADER.pack(
+            wire.MAGIC, wire.VERSION, wire.FRAME_PREDICT,
+            wire.DTYPE_UINT8, 0, bad_n, 32, 32, 3,
+        )
+        claimed = len(hdr) + 64  # far less than bad_n images of payload
+        with socket.create_connection((fe.host, fe.port)) as s:
+            s.sendall(
+                (
+                    "POST /predict HTTP/1.1\r\n"
+                    f"Content-Type: {wire.CONTENT_TYPE}\r\n"
+                    f"Content-Length: {claimed}\r\n\r\n"
+                ).encode() + hdr
+            )  # 24 header bytes, NONE of the payload
+            status, _, payload = _recv_response(s)
+            assert status == 400
+            assert "capped" in json.loads(payload)["error"]
+        assert stub.calls == 0
+    finally:
+        fe.stop()
+
+
+def test_shed_tiers_bulk_first_interactive_holds():
+    """Load-shed tiers: with the dispatch backlog over the bulk
+    threshold but under the interactive one, a bulk-flagged frame is
+    429'd (counted shed) while an interactive request still flows —
+    priority read from the frame flags, no decode spent on the shed."""
+    backend = GatedBackend()
+    fe = EdgeFrontend(
+        backend, workers=1, shed_pending=64, shed_pending_bulk=1
+    ).start()
+    try:
+        # HttpTarget.submit is synchronous — park it on a helper thread
+        # so the gated request can pin the single worker while we probe
+        results = {}
+        t_bg = HttpTarget(fe.url, wire="json")
+        bg = threading.Thread(
+            target=lambda: results.update(
+                bg=t_bg.submit(_images(1)).result()
+            )
+        )
+        bg.start()
+        deadline = time.monotonic() + 10
+        while fe._pending < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fe._pending >= 1
+        x = _images(1, seed=3)
+        bulk_frame = wire.encode_request(x, priority="bulk")
+        with socket.create_connection((fe.host, fe.port)) as s:
+            s.sendall(
+                (
+                    f"POST /predict HTTP/1.1\r\n"
+                    f"Content-Type: {wire.CONTENT_TYPE}\r\n"
+                    f"Content-Length: {len(bulk_frame)}\r\n\r\n"
+                ).encode() + bulk_frame
+            )
+            status, _, payload = _recv_response(s)
+            assert status == 429
+            assert "shedding" in json.loads(payload)["error"]
+        assert int(fe.c_shed.value) == 1
+        # interactive traffic still admitted (backlog < shed_pending)
+        t_fg = HttpTarget(fe.url, wire="binary")
+        fg = threading.Thread(
+            target=lambda: results.update(
+                fg=t_fg.submit(x).result()
+            )
+        )
+        fg.start()
+        deadline = time.monotonic() + 10
+        while fe._pending < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fe._pending == 2  # admitted, queued behind the gate
+        backend.gate.set()
+        bg.join(timeout=30)
+        fg.join(timeout=30)
+        assert results["bg"] is not None and results["fg"] is not None
+        t_bg.close()
+        t_fg.close()
+    finally:
+        backend.gate.set()
+        fe.stop()
+
+
+# -- observability + lifecycle ------------------------------------------
+
+
+def test_metrics_endpoint_exports_edge_family(lenet_stack):
+    """GET /metrics off the event edge is a pure loop-thread snapshot
+    carrying BOTH metric families: serve.http_* (the report contract)
+    and serve.edge.* (OBSERVABILITY.md)."""
+    import urllib.request
+
+    _, _, event = lenet_stack
+    target = HttpTarget(event.url, wire="binary")
+    assert target.submit(_images(1)).result() is not None
+    target.close()
+    with urllib.request.urlopen(event.url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    for needle in (
+        "pct_serve_http_requests",
+        "pct_serve_edge_accepts",
+        "pct_serve_edge_connections",
+        "pct_serve_edge_read_ms_bucket",
+    ):
+        assert needle in text, needle
+
+
+def test_graceful_drain_no_thread_or_fd_leak():
+    """stop() must leave NOTHING behind: no loop thread, no worker
+    thread, no fd (listener, wakeup pipe, accepted connections), and
+    the port stops answering. Pinned with /proc/self/fd, the strictest
+    leak oracle this platform offers."""
+    def open_fds():
+        return set(os.listdir("/proc/self/fd"))
+
+    stub = StubBackend()
+    threads_before = set(threading.enumerate())
+    fds_before = open_fds()
+    fe = EdgeFrontend(stub).start()
+    target = HttpTarget(fe.url)
+    rep = run_load(target, clients=4, requests_per_client=4)
+    assert rep["failed"] == 0
+    host, port = fe.host, fe.port
+    fe.stop()
+    target.close()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaked_threads = set(threading.enumerate()) - threads_before
+        leaked_fds = open_fds() - fds_before
+        if not leaked_threads and not leaked_fds:
+            break
+        time.sleep(0.05)
+    assert not leaked_threads, [t.name for t in leaked_threads]
+    assert not leaked_fds, leaked_fds
+    with pytest.raises(OSError):
+        socket.create_connection((host, port), timeout=2)
+    fe.stop()  # idempotent: a second drain is a no-op, not a crash
+
+
+def test_drain_answers_in_flight_requests():
+    """A request already dispatched to a worker when stop() lands must
+    still be answered and flushed before its connection closes."""
+    backend = GatedBackend()
+    fe = EdgeFrontend(backend, workers=1).start()
+    target = HttpTarget(fe.url, wire="json")
+    results = {}
+    sender = threading.Thread(
+        target=lambda: results.update(
+            out=target.submit(_images(1)).result()
+        )
+    )
+    sender.start()
+    deadline = time.monotonic() + 10
+    while fe._pending < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fe._pending == 1  # in a worker's hands when the drain lands
+    stopper = threading.Thread(target=fe.stop)
+    stopper.start()
+    time.sleep(0.1)
+    backend.gate.set()
+    sender.join(timeout=30)
+    assert results["out"] is not None  # answered mid-drain
+    stopper.join(timeout=30)
+    assert not stopper.is_alive()
+    target.close()

@@ -70,6 +70,8 @@ def test_rule_registry_has_at_least_sixteen_rules():
         "cond-wait-discipline", "lock-leak", "metric-name-drift",
     ):
         assert name in rule_names()
+    # the event-loop edge PR's loop-stall rule
+    assert "blocking-in-event-loop" in rule_names()
 
 
 def test_suppression_requires_reason(tmp_path):
@@ -1713,3 +1715,133 @@ def test_json_report_schema():
     assert set(rep["counts"]) == {"total", "open", "suppressed", "baselined"}
     assert isinstance(rep["rules"], list) and len(rep["rules"]) >= 8
     json.dumps(rep)  # round-trips
+
+
+# ---------------------------------------------------------------------
+# blocking-in-event-loop (the event-loop edge PR)
+# ---------------------------------------------------------------------
+
+
+def test_blocking_in_event_loop_positive(tmp_path):
+    """Unbounded blocking inside a selectors callback — directly and in
+    a helper only reachable through one — stalls every connection the
+    loop holds. queue.get() with no timeout and a bare lock.acquire()
+    both fire; the finding names the registered entry."""
+    src = """
+    import queue
+    import selectors
+    import threading
+
+    class Loop:
+        def __init__(self):
+            self._sel = selectors.DefaultSelector()
+            self._q = queue.Queue()
+            self._lock = threading.Lock()
+
+        def start(self, sock):
+            sock.setblocking(False)
+            self._sel.register(
+                sock, selectors.EVENT_READ, self._on_readable
+            )
+
+        def _on_readable(self, key, mask):
+            item = self._q.get()  # parks the loop behind a producer
+            self._handle(item)
+
+        def _handle(self, item):
+            self._lock.acquire()  # no timeout: parks behind the holder
+            try:
+                item.run()
+            finally:
+                self._lock.release()
+    """
+    found = run_rule(tmp_path, src, "blocking-in-event-loop")
+    assert len(found) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "get() without a timeout" in msgs
+    assert "acquire() without a timeout" in msgs
+    # every finding names the loop entry the blocking call rides in on
+    for f in found:
+        assert "_on_readable" in f.message
+
+
+def test_blocking_in_event_loop_negative(tmp_path):
+    """The sanctioned edge shape is quiet: socket ops in a module that
+    calls setblocking(False), put_nowait handoff, micro `with lock:`
+    critical sections, bounded get(timeout=...), and a worker THREAD
+    whose blocking get() is off-loop (Thread target is not a loop
+    entry)."""
+    src = """
+    import queue
+    import selectors
+    import threading
+
+    class Edge:
+        def __init__(self):
+            self._sel = selectors.DefaultSelector()
+            self._q = queue.Queue()
+            self._lock = threading.Lock()
+            self._conns = []
+
+        def start(self, lsock):
+            lsock.setblocking(False)
+            self._sel.register(
+                lsock, selectors.EVENT_READ, self._on_accept
+            )
+            self._thread = threading.Thread(target=self._worker)
+            self._thread.start()
+
+        def _on_accept(self, key, mask):
+            sock, _ = key.fileobj.accept()  # non-blocking listener
+            sock.setblocking(False)
+            self._q.put_nowait(sock)
+            with self._lock:  # bounded micro critical-section
+                self._conns.append(sock)
+
+        def _on_timer(self, key, mask):
+            try:
+                return self._q.get(timeout=0.01)  # bounded: fine
+            except queue.Empty:
+                return None
+
+        def _worker(self):
+            while True:
+                item = self._q.get()  # blocking off-loop: the POINT
+                if item is None:
+                    return
+
+        def stop(self):
+            self._q.put_nowait(None)
+            self._thread.join(timeout=5.0)
+    """
+    assert run_rule(tmp_path, src, "blocking-in-event-loop") == []
+
+
+def test_blocking_in_event_loop_self_run_clean_and_not_vacuous():
+    """The shipped event-loop edge passes its own rule with ZERO noqa
+    suppressions — and not because the rule saw nothing: the project
+    graph must actually track edge.py's registered callbacks and their
+    helpers."""
+    from pytorch_cifar_tpu.lint.engine import _Project
+
+    serve_dir = os.path.join(PKG, "serve")
+    edge = os.path.join(serve_dir, "edge.py")
+    with open(edge) as f:
+        assert "noqa[blocking-in-event-loop]" not in f.read()
+    run = lint_paths(
+        [serve_dir], repo_root=REPO,
+        rules=rules_by_name(["blocking-in-event-loop"]),
+    )
+    found = [
+        f for f in run.findings
+        if f.rule == "blocking-in-event-loop" and f.status == "open"
+    ]
+    assert found == [], "\n".join(f.render() for f in found)
+    # non-vacuous: both loops' callbacks (frontend + replica pool) and
+    # the parse/shed/response helpers behind them are in the reach set
+    proj = _Project(REPO, [edge])
+    reach = proj.graph().loop_callback_reachable_for(edge)
+    names = {getattr(n, "name", "") for n in reach}
+    assert {"_on_accept", "_feed", "_begin_request",
+            "_on_conn_readable"} <= names
+    assert len(names) >= 20

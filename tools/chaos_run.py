@@ -948,6 +948,235 @@ def router_drill(args, work: str) -> dict:
     }
 
 
+def edge_drill(args, work: str) -> dict:
+    """The event-loop edge drill (SERVING.md "Event-loop edge"): the
+    two resource-exhaustion attacks the edge's protections exist for —
+    a slow-loris request trickle and a hold-open connection flood —
+    plus the router drill's replica SIGKILL, all against an
+    ``--edge event`` fleet under sustained mixed-wire OPEN-LOOP load
+    (the async client: 32 logical connections, one driver thread).
+
+    Phases:
+      0. fleet-up: router_run.py --edge event spawns 2 replicas behind
+         the event-loop router frontend; /predict bit-identity probed
+         across replica 0 / replica 1 / router x JSON / binary.
+      1. steady: async load -> p99_steady, zero failures.
+      2. slow-loris: trickle one header byte per 0.5 s at the router
+         edge while the load runs — the per-connection read deadline
+         (10 s default) must close the attacker mid-trickle
+         (closed_by_server == 1, pct_serve_edge_loris_closed >= 1) and
+         the foreground traffic must not drop a request.
+      3. conn-flood: 256 hold-open sockets against the same edge under
+         load — absorbed on the one loop thread (no handler threads to
+         burn), reaped at attacker close, foreground failed == 0.
+      4. kill: SIGKILL replica 0 mid-load -> bounded loss, eviction.
+      5. drain: SIGTERM to router_run must exit 0 with its JSON record.
+    """
+    import threading
+    import urllib.request
+
+    from pytorch_cifar_tpu import faults
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_async_load
+
+    ckpt_dir = os.path.join(work, "ckpt")
+    print(f"==> [edge] training checkpoint -> {ckpt_dir}", file=sys.stderr)
+    run_to_completion(train_cmd(args, ckpt_dir), child_env(), args.timeout)
+
+    env = child_env()
+    env.pop("XLA_FLAGS", None)  # replicas: production 1-device shape
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "router_run.py"),
+        "--ckpt", ckpt_dir,
+        "--model", args.model,
+        "--replicas", "2",
+        "--buckets", "1", "4", "8",
+        "--aot_cache", os.path.join(work, "aot"),
+        "--deadline_ms", "2000",
+        "--probe_s", "0.2",
+        "--max_wait_ms", "1",
+        "--edge", "event",
+    ]
+    print("==> [edge] fleet up (--edge event)", file=sys.stderr)
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+
+    replica_re = re.compile(r"==> replica (\d+) pid=(\d+) url=(\S+)")
+    router_re = re.compile(r"==> router: serving on (\S+)")
+    replicas = {}
+    router_url = None
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        line = proc.stderr.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"router_run exited rc={proc.returncode} before the "
+                    "router came up"
+                )
+            time.sleep(0.05)
+            continue
+        sys.stderr.write(line)
+        m = replica_re.search(line)
+        if m:
+            replicas[int(m.group(1))] = (int(m.group(2)), m.group(3))
+        m = router_re.search(line)
+        if m:
+            router_url = m.group(1)
+            break
+    if router_url is None or len(replicas) != 2:
+        proc.kill()
+        raise SystemExit("timed out waiting for the fleet topology")
+    drain_t = threading.Thread(
+        target=lambda: [sys.stderr.write(ln) for ln in proc.stderr],
+        name="edge-stderr-drain", daemon=True,
+    )
+    drain_t.start()
+
+    host, port = router_url.split("//", 1)[1].split(":")
+    port = int(port)
+
+    def edge_counter(name: str) -> float:
+        """One pct_serve_edge_* counter off the live /metrics page."""
+        with urllib.request.urlopen(router_url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for ln in text.splitlines():
+            if ln.startswith(name + " "):
+                return float(ln.rsplit(" ", 1)[-1])
+        return 0.0
+
+    # bit-identity across the event fleet AND across encodings: replica
+    # frontends, the router's EdgePool transport, and the router's own
+    # event frontend must all return byte-equal logits
+    probe = np.random.RandomState(7).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    outs = [
+        HttpTarget(u, wire=w).submit(probe).result()
+        for u in (replicas[0][1], replicas[1][1], router_url)
+        for w in ("json", "binary")
+    ]
+    bit_identical = all(np.array_equal(outs[0], o) for o in outs[1:])
+
+    def load_phase(tag, duration_s, seed):
+        rep = run_async_load(
+            router_url,
+            clients=32,
+            requests_per_client=10**6,
+            images_max=4,
+            wire="mixed",
+            seed=seed,
+            duration_s=duration_s,
+            bulk_fraction=0.3,
+        )
+        print(
+            f"==> [edge] {tag}: {rep['requests']} reqs "
+            f"p99={rep['p99_ms']:.1f}ms hedged={rep['hedged']} "
+            f"failed={rep['failed']}", file=sys.stderr,
+        )
+        return rep
+
+    print("==> [edge] phase 1: steady state (32 async clients)",
+          file=sys.stderr)
+    steady = load_phase("steady", 5.0, seed=1)
+
+    print("==> [edge] phase 2: slow-loris under load", file=sys.stderr)
+    loris_result = {}
+
+    def loris():
+        # read_deadline_s defaults to 10: trickle past it and the edge
+        # must reset us mid-trickle
+        loris_result.update(faults.slow_loris(
+            host, port, duration_s=14.0, interval_s=0.5,
+        ))
+
+    loris_t = threading.Thread(target=loris, name="slow-loris")
+    loris_t.start()
+    loris_fg = load_phase("loris-foreground", 15.0, seed=2)
+    loris_t.join(timeout=30)
+    loris_closed = edge_counter("pct_serve_edge_loris_closed")
+
+    print("==> [edge] phase 3: conn-flood under load", file=sys.stderr)
+    flood_result = {}
+
+    def flood():
+        flood_result.update(faults.conn_flood(
+            host, port, connections=256, hold_s=2.0,
+        ))
+
+    flood_t = threading.Thread(target=flood, name="conn-flood")
+    flood_t.start()
+    flood_fg = load_phase("flood-foreground", 5.0, seed=3)
+    flood_t.join(timeout=30)
+
+    print("==> [edge] phase 4: SIGKILL replica 0 under load",
+          file=sys.stderr)
+    kill_at = threading.Timer(
+        2.0, os.kill, (replicas[0][0], signal.SIGKILL)
+    )
+    kill_at.start()
+    killed = load_phase("kill", 6.0, seed=4)
+    kill_at.join()
+
+    print("==> [edge] phase 5: drain", file=sys.stderr)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=args.timeout)
+    drain_t.join(timeout=10)
+    rec_run = None
+    for ln in out.splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                rec_run = json.loads(ln)
+            except ValueError:
+                continue
+    if rec_run is None:
+        raise SystemExit("router_run printed no JSON record")
+
+    # the verdict: both attacks bounded (attacker closed by the server,
+    # zero foreground failures while each ran), bounded loss during the
+    # kill window only, eviction happened, clean drain
+    loss_bound = killed["failed"] <= max(4, killed["requests"] // 20)
+    ok = (
+        proc.returncode == 0
+        and bit_identical
+        and steady["requests"] > 0
+        and steady["failed"] == 0
+        and loris_result.get("closed_by_server") == 1
+        and loris_closed >= 1
+        and loris_fg["failed"] == 0
+        and flood_result.get("opened", 0) >= 200
+        and flood_result.get("refused", 0) == 0
+        and flood_fg["failed"] == 0
+        and killed["requests"] > 0
+        and loss_bound
+        and rec_run["router"]["evictions"] >= 1
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "edge",
+        "match": ok,
+        "transport": rec_run["router"].get("transport"),
+        "bit_identical": bit_identical,
+        "wire": "mixed",
+        "p99_steady_ms": round(steady["p99_ms"], 2),
+        "p99_loris_ms": round(loris_fg["p99_ms"], 2),
+        "p99_flood_ms": round(flood_fg["p99_ms"], 2),
+        "p99_kill_ms": round(killed["p99_ms"], 2),
+        "requests": steady["requests"] + loris_fg["requests"]
+        + flood_fg["requests"] + killed["requests"],
+        "loris": loris_result,
+        "loris_closed_counter": loris_closed,
+        "flood": flood_result,
+        "failed_during_loris": loris_fg["failed"],
+        "failed_during_flood": flood_fg["failed"],
+        "failed_during_kill": killed["failed"],
+        "hedged_during_kill": killed["hedged"],
+        "evictions": rec_run["router"]["evictions"],
+        "router_rc": proc.returncode,
+    }
+
+
 def mesh_drill(args, work: str) -> dict:
     """The cross-host drill (SERVING.md "Multi-process mesh replica"):
     SIGKILL one FOLLOWER of a live 2-process mesh replica under load.
@@ -1913,7 +2142,7 @@ def main() -> int:
         "--mode",
         choices=(
             "sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt",
-            "router", "canary", "zoo", "mesh", "elastic",
+            "router", "canary", "zoo", "mesh", "elastic", "edge",
         ),
         default="sigterm",
     )
@@ -1961,6 +2190,7 @@ def main() -> int:
 
     if args.mode in (
         "serve", "ckpt", "router", "canary", "zoo", "mesh", "elastic",
+        "edge",
     ):
         record = {
             "serve": serve_drill,
@@ -1970,6 +2200,7 @@ def main() -> int:
             "zoo": zoo_drill,
             "mesh": mesh_drill,
             "elastic": elastic_drill,
+            "edge": edge_drill,
         }[args.mode](args, work)
         print(json.dumps(record))
         if record["match"] and not args.out:

@@ -98,6 +98,12 @@ def main() -> int:
     p.add_argument("--bulk_fraction", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument(
+        "--edge", choices=("threaded", "event"), default="threaded",
+        help="I/O layer for the whole fleet: every replica's frontend "
+        "(seed and scale-up alike), the router's replica transport, and "
+        "the fleet frontend (SERVING.md 'Event-loop edge')",
+    )
     args = p.parse_args()
 
     from pytorch_cifar_tpu.obs import MetricsRegistry
@@ -132,6 +138,7 @@ def main() -> int:
         num_devices=args.replica_devices,
         host=args.host,
         timeout_s=args.timeout,
+        extra_args=("--edge", args.edge),
     )
 
     # seed fleet: replica 0 alone first (it fills the AOT cache), then
@@ -147,14 +154,21 @@ def main() -> int:
             file=sys.stderr,
         )
 
+    if args.edge == "event":
+        from pytorch_cifar_tpu.serve.edge import EdgeFrontend
+        frontend_cls = EdgeFrontend
+    else:
+        frontend_cls = ServingFrontend
+
     registry = MetricsRegistry()
     router = Router(
         [r.url for r in seeds],
         registry=registry,
         probe_s=args.probe_s,
         fail_after=args.fail_after,
+        transport=args.edge,
     ).start()
-    frontend = ServingFrontend(
+    frontend = frontend_cls(
         router, host=args.host, port=args.port, registry=registry
     ).start()
     print(f"==> fleet: serving on {frontend.url}", file=sys.stderr)

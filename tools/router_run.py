@@ -119,6 +119,7 @@ def spawn_replica(args, idx: int) -> ReplicaProc:
         "--deadline_ms", str(args.deadline_ms),
         "--num_devices", str(args.replica_devices),
         "--poll_s", str(args.poll_s),
+        "--edge", args.edge,
     ]
     if args.aot_cache:
         cmd += ["--aot_cache", args.aot_cache]
@@ -306,12 +307,24 @@ def main() -> int:
     p.add_argument("--bulk_fraction", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument(
+        "--edge", choices=("threaded", "event"), default="threaded",
+        help="I/O layer for the whole stack: replicas' frontends, the "
+        "router's replica transport, and the router-process frontend "
+        "(SERVING.md 'Event-loop edge'); answers are bit-identical",
+    )
     args = p.parse_args()
 
     from pytorch_cifar_tpu.obs import MetricsRegistry
     from pytorch_cifar_tpu.serve.frontend import ServingFrontend
     from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
     from pytorch_cifar_tpu.serve.router import Router
+
+    if args.edge == "event":
+        from pytorch_cifar_tpu.serve.edge import EdgeFrontend
+        frontend_cls = EdgeFrontend
+    else:
+        frontend_cls = ServingFrontend
 
     # stage the fleet: replica 0 alone (it fills the AOT cache), then
     # the rest in parallel (they import the cached executables)
@@ -339,8 +352,9 @@ def main() -> int:
         registry=registry,
         probe_s=args.probe_s,
         fail_after=args.fail_after,
+        transport=args.edge,
     ).start()
-    frontend = ServingFrontend(
+    frontend = frontend_cls(
         router, host=args.host, port=args.port, registry=registry
     ).start()
     print(f"==> router: serving on {frontend.url}", file=sys.stderr)
