@@ -755,6 +755,12 @@ class EdgeFrontend:
             and backlog >= self.shed_pending_bulk
         ):
             self.c_shed.inc()
+            # the body is fully consumed: rearm the parser BEFORE the
+            # 429 is queued, or the next keep-alive request would land
+            # in _feed_body against a None body
+            conn.state = _READ_HEAD
+            conn.content_length = 0
+            conn.body_filled = 0
             self._send_error(
                 conn, 429,
                 f"edge shedding load ({backlog} requests pending)",
@@ -817,6 +823,9 @@ class EdgeFrontend:
     def _queue_response(self, conn: _Conn, payload: bytes) -> None:
         if conn.state == _CLOSED:
             return
+        # a response to a Connection: close request advertises close in
+        # its header; the flush path must actually close the socket
+        conn.close_after = conn.close_after or not conn.keep_alive
         if not conn.out:
             conn.t_write_start = time.monotonic()
         conn.out.append(memoryview(payload))
@@ -1462,9 +1471,14 @@ class EdgePool:
         no_response_bytes = (
             conn.status == 0 and not conn.rbuf and conn.body_filled == 0
         )
-        if conn.reused and no_response_bytes and not ex.retried:
+        if (
+            conn.reused and no_response_bytes and not ex.retried
+            and time.monotonic() < ex.deadline
+        ):
             # stale keep-alive: retry ONCE on a fresh connection with
             # the complete buffered request (never a half-consumed one)
+            # — but only while the caller is still waiting; a retry of
+            # an expired exchange just burns replica capacity
             ex.retried = True
             self._assign(ex)
             return

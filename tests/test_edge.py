@@ -480,6 +480,94 @@ def test_shed_tiers_bulk_first_interactive_holds():
         fe.stop()
 
 
+def test_keep_alive_reuse_after_shed_429():
+    """A shed 429 must leave the keep-alive connection parseable: the
+    NEXT request on the same socket is admitted and answered (the shed
+    consumed the body, so the parser must be rearmed for a new head)."""
+    backend = GatedBackend()
+    fe = EdgeFrontend(
+        backend, workers=1, shed_pending=64, shed_pending_bulk=1
+    ).start()
+    try:
+        results = {}
+        t_bg = HttpTarget(fe.url, wire="json")
+        bg = threading.Thread(
+            target=lambda: results.update(
+                bg=t_bg.submit(_images(1)).result()
+            )
+        )
+        bg.start()
+        deadline = time.monotonic() + 10
+        while fe._pending < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fe._pending >= 1
+        x = _images(1, seed=7)
+        bulk_frame = wire.encode_request(x, priority="bulk")
+        inter_frame = wire.encode_request(x, priority="interactive")
+        with socket.create_connection((fe.host, fe.port)) as s:
+            s.sendall(
+                (
+                    f"POST /predict HTTP/1.1\r\n"
+                    f"Content-Type: {wire.CONTENT_TYPE}\r\n"
+                    f"Content-Length: {len(bulk_frame)}\r\n\r\n"
+                ).encode() + bulk_frame
+            )
+            status, _, payload = _recv_response(s)
+            assert status == 429
+            assert "shedding" in json.loads(payload)["error"]
+            # the SAME socket now carries an interactive request; it
+            # must be parsed as a fresh head and admitted
+            s.sendall(
+                (
+                    f"POST /predict HTTP/1.1\r\n"
+                    f"Content-Type: {wire.CONTENT_TYPE}\r\n"
+                    f"Content-Length: {len(inter_frame)}\r\n\r\n"
+                ).encode() + inter_frame
+            )
+            deadline = time.monotonic() + 10
+            while fe._pending < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert fe._pending == 2  # admitted, queued behind the gate
+            backend.gate.set()
+            status, _, payload = _recv_response(s)
+            assert status == 200
+            got, _ = wire.decode_response(payload)
+            assert got.shape == (1, 10)
+        bg.join(timeout=30)
+        assert results["bg"] is not None
+        t_bg.close()
+    finally:
+        backend.gate.set()
+        fe.stop()
+
+
+def test_connection_close_honored_on_success():
+    """A 200 answering a 'Connection: close' request both advertises
+    close AND closes the socket after the flush — otherwise the idle
+    connection (no deadline) leaks until the client gives up."""
+    stub = StubBackend()
+    fe = EdgeFrontend(stub).start()
+    try:
+        body = json.dumps({"images": _images(1).tolist()}).encode()
+        with socket.create_connection((fe.host, fe.port)) as s:
+            s.sendall(
+                (
+                    "POST /predict HTTP/1.1\r\n"
+                    "Connection: close\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n"
+                ).encode() + body
+            )
+            status, headers, _ = _recv_response(s)
+            assert status == 200
+            assert headers["connection"] == "close"
+            s.settimeout(5)
+            assert s.recv(256) == b""  # server closed after the flush
+        assert stub.calls == 1
+    finally:
+        fe.stop()
+
+
 # -- observability + lifecycle ------------------------------------------
 
 
