@@ -1669,6 +1669,246 @@ def serve_elastic_bench(args) -> int:
     return 0
 
 
+def serve_rollout_bench(args) -> int:
+    """``--serve-rollout``: the rolling-deploy A/B (SERVING.md "Durable
+    control plane"). Two fleet_run.py children each serve a 2-replica
+    fleet under the same sustained closed-loop load while a
+    generation-stamped publish lands in their live dir:
+
+    - **watch**: ``--replica_watch`` — the pre-PR world: every replica's
+      own hot-reload watcher swaps the checkpoint independently, with no
+      coordination, no canary gate, and no surge capacity (replicas can
+      reload simultaneously).
+    - **rollout**: ``--rollouts --journal`` — the controller runs a
+      generation-aware rolling deploy: surge ONE gated new-generation
+      replica (warm from the shared AOT cache — ``compiles == 0``), then
+      convert the fleet one replica at a time.
+
+    The headline ``value`` is the coordinated ROLLING-DEPLOY WALL TIME:
+    publish landing -> every replica reporting the new generation on the
+    edge's ``/healthz`` (and the fleet back at pre-deploy strength). The
+    uncoordinated swap time and the p99 observed during each deploy
+    window ride along — the rollout pays its wall time for gating +
+    surge capacity; the A/B prices exactly that trade. Like headline(),
+    this parent never runs device work (replicas own the devices)."""
+    import re as _re
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+    from pytorch_cifar_tpu.train.checkpoint import publish_checkpoint
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="bench_rollout_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # replicas: production 1-device shape
+
+    ckpt = os.path.join(work, "ckpt")
+    print(
+        f"==> [rollout] training tiny checkpoint -> {ckpt}",
+        file=sys.stderr,
+    )
+    r = subprocess.run(
+        [
+            sys.executable, os.path.join(here, "train.py"),
+            "--model", args.model, "--synthetic_data",
+            "--synthetic_train_size", "256", "--synthetic_test_size", "64",
+            "--batch_size", "64", "--epochs", "1", "--output_dir", ckpt,
+            "--async_save", "off",
+        ],
+        env=env, capture_output=True, text=True, timeout=900, cwd=here,
+    )
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise SystemExit("rollout bench: training the checkpoint failed")
+
+    fleet_re = _re.compile(r"==> fleet: serving on (\S+)")
+    surge_re = _re.compile(
+        r"==> fleet: (?:rollout-surge|rollout-up) replica \d+ url=\S+ "
+        r"pid=\d+ compiles=(\S+)"
+    )
+
+    def healthz(url):
+        try:
+            with urllib.request.urlopen(url + "/healthz", timeout=10) as h:
+                return json.load(h)
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read().decode("utf-8"))
+
+    def fleet_generations(url):
+        reps = healthz(url).get("replicas", [])
+        return [rep.get("generation") for rep in reps]
+
+    def run_arm(tag, extra_cmd):
+        live = os.path.join(work, f"live_{tag}")
+        publish_checkpoint(
+            ckpt, live, extra_meta={"promotion": {"generation": 1}}
+        )
+        cmd = [
+            sys.executable, os.path.join(here, "tools", "fleet_run.py"),
+            "--ckpt", live,
+            "--model", args.model,
+            "--replicas", "2",
+            "--min_replicas", "2",
+            "--max_replicas", "3",
+            "--buckets", "1", "4", "8",
+            "--aot_cache", os.path.join(work, "aot"),
+            "--max_wait_ms", "1",
+            "--probe_s", "0.2",
+            "--control_interval_s", "0.25",
+            # the scaling band is parked wide open: the only membership
+            # churn in the window is the deploy itself
+            "--queue_high", "1000", "--queue_low", "0",
+            "--up_after_s", "600", "--down_after_s", "600",
+            "--up_cooldown_s", "600", "--down_cooldown_s", "600",
+        ] + extra_cmd
+        print(f"==> [rollout] {tag} fleet up (2 replicas)", file=sys.stderr)
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, cwd=here,
+        )
+        state = {"url": None, "surge_compiles": []}
+        ready = threading.Event()
+
+        def watch():
+            for line in proc.stderr:
+                sys.stderr.write(line)
+                m = fleet_re.search(line)
+                if m:
+                    state["url"] = m.group(1)
+                    ready.set()
+                m = surge_re.search(line)
+                if m:
+                    state["surge_compiles"].append(m.group(1))
+            ready.set()  # EOF unblocks the waiter on a crash
+
+        watcher = threading.Thread(
+            target=watch, name=f"fleet-watch-{tag}", daemon=True
+        )
+        watcher.start()
+        if not ready.wait(600) or state["url"] is None:
+            proc.kill()
+            proc.communicate()
+            raise SystemExit(f"rollout bench: {tag} fleet never came up")
+        url = state["url"]
+
+        # sustained load in 4 s windows; the deploy lands mid-stream
+        windows = []
+        load_stop = threading.Event()
+
+        def load_loop():
+            n = 0
+            while not load_stop.is_set():
+                n += 1
+                t0 = time.perf_counter()
+                rep = run_load(
+                    HttpTarget(url), clients=2,
+                    requests_per_client=10**6, images_max=4,
+                    seed=n, duration_s=4.0,
+                )
+                windows.append((t0, time.perf_counter(), rep))
+
+        load_t = threading.Thread(target=load_loop, name=f"load-{tag}")
+        load_t.start()
+        time.sleep(4.0)  # one settled window before the publish
+
+        print(
+            f"==> [rollout] {tag}: publishing generation 2 under load",
+            file=sys.stderr,
+        )
+        t_publish = time.perf_counter()
+        publish_checkpoint(
+            ckpt, live, extra_meta={"promotion": {"generation": 2}}
+        )
+        deploy_s = None
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            gens = fleet_generations(url)
+            if len(gens) == 2 and all(g == 2 for g in gens):
+                deploy_s = time.perf_counter() - t_publish
+                break
+            time.sleep(0.2)
+        t_converged = time.perf_counter()
+        time.sleep(4.0)  # one settled window after convergence
+        load_stop.set()
+        load_t.join()
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=300)
+        watcher.join(timeout=10)
+        if deploy_s is None:
+            raise SystemExit(
+                f"rollout bench: the {tag} fleet never converged on "
+                "generation 2"
+            )
+        rec = {}
+        for ln in out.splitlines():
+            s = ln.strip()
+            if s.startswith("{"):
+                try:
+                    cand = json.loads(s)
+                except ValueError:
+                    continue
+                if cand.get("harness") == "fleet_run":
+                    rec = cand
+        deploy_windows = [
+            rep for (w0, w1, rep) in windows
+            if w1 >= t_publish and w0 <= t_converged
+        ]
+        return {
+            "deploy_s": deploy_s,
+            "p99_deploy_ms": max(
+                (rep["p99_ms"] for rep in deploy_windows), default=0.0
+            ),
+            "requests": sum(rep["requests"] for (_, _, rep) in windows),
+            "failed": sum(rep["failed"] for (_, _, rep) in windows),
+            "surge_compiles": state["surge_compiles"],
+            "record": rec,
+        }
+
+    watch_arm = run_arm("watch", ["--replica_watch"])
+    rollout_arm = run_arm(
+        "rollout",
+        ["--rollouts", "--journal", os.path.join(work, "fleet.journal")],
+    )
+    if not rollout_arm["surge_compiles"] or any(
+        c != "0" for c in rollout_arm["surge_compiles"]
+    ):
+        raise SystemExit(
+            "rollout bench: the deploy's new-generation replicas were "
+            f"not warm (compiles={rollout_arm['surge_compiles']}) — the "
+            "AOT-cache pin failed"
+        )
+
+    rec = core_record(
+        f"serve_rollout_deploy_{args.model}_cpu",
+        round(rollout_arm["deploy_s"], 3),
+        unit="seconds",
+    )
+    rec.update(
+        watch_swap_s=round(watch_arm["deploy_s"], 3),
+        rollout_vs_watch=round(
+            rollout_arm["deploy_s"] / max(watch_arm["deploy_s"], 1e-9), 4
+        ),
+        p99_during_rollout_ms=round(rollout_arm["p99_deploy_ms"], 2),
+        p99_during_watch_swap_ms=round(watch_arm["p99_deploy_ms"], 2),
+        surge_compiles=[int(c) for c in rollout_arm["surge_compiles"]],
+        rollouts=rollout_arm["record"].get("rollouts"),
+        scale_ups=rollout_arm["record"].get("scale_ups"),
+        journal_seq=rollout_arm["record"].get("journal_seq"),
+        failed=watch_arm["failed"] + rollout_arm["failed"],
+        requests=watch_arm["requests"] + rollout_arm["requests"],
+    )
+    print(json.dumps(rec))
+    shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
 def headline(args) -> int:
     """The default scoreboard protocol: median of ``--captures`` fresh
     subprocess runs of the production epoch path, plus one ``--step``
@@ -1840,6 +2080,15 @@ def main() -> int:
         "(elastic_vs_fixed) in the single-line record",
     )
     parser.add_argument(
+        "--serve-rollout", action="store_true", dest="serve_rollout",
+        help="measure generation-aware rolling deploys (serve/fleet.py, "
+        "SERVING.md 'Durable control plane'): coordinated rolling-deploy "
+        "wall time (publish -> whole fleet on the new generation, surge "
+        "warm from the AOT cache) as the headline value, with the "
+        "uncoordinated --replica_watch swap time and the p99 observed "
+        "during each deploy window riding along (rollout_vs_watch)",
+    )
+    parser.add_argument(
         "--serve-zoo", action="store_true", dest="serve_zoo",
         help="measure multi-tenant zoo serving (serve/tenancy.py, "
         "SERVING.md 'Multi-tenant zoo serving'): per-model img/s under "
@@ -1891,6 +2140,11 @@ def main() -> int:
         # fleet orchestration: replicas own the devices; this parent
         # moves bytes, watches the controller, and times its reaction
         return serve_elastic_bench(args)
+
+    if args.serve_rollout:
+        # deploy orchestration: same split — this parent publishes
+        # generations and times the fleet's convergence on them
+        return serve_rollout_bench(args)
 
     if not (
         args.pipeline

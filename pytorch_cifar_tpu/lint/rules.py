@@ -2267,6 +2267,207 @@ class BlockingInEventLoop(Rule):
         return out
 
 
+# ---------------------------------------------------------------------
+# 19. journal-write-ordering
+# ---------------------------------------------------------------------
+
+# the actuations a controller journal exists to make durable: child
+# spawns, process signals, and router traffic shifts
+_JOURNAL_ACTUATION_QUALNAMES = (
+    "subprocess.Popen", "os.kill", "os.killpg",
+)
+_JOURNAL_ACTUATION_ATTRS = (
+    "add_replica", "remove_replica", "decommission",
+    "send_signal", "terminate", "kill",
+)
+
+
+class JournalWriteOrdering(Rule):
+    name = "journal-write-ordering"
+    summary = (
+        "a control-plane journal append that is not fsync'd before it "
+        "returns, an actuation (process spawn/signal, router traffic "
+        "shift) taken BEFORE the journal append that records it, or a "
+        "journal snapshot commit marker written before its payload — "
+        "each breaks the replay contract: a relaunched controller "
+        "trusts the journal, so evidence must be durable before the "
+        "action, and the marker must be the LAST snapshot step "
+        "(serve/journal.py's append/compact shape)"
+    )
+
+    @staticmethod
+    def _is_journal_append(node: ast.AST) -> bool:
+        """A call that durably records a control-plane action: the
+        ``<journal>.append(...)`` method, or a wrapper named for it
+        (``self._journal(...)``, ``append_journal(...)``)."""
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "append":
+                recv = qualname(func.value) or ast.dump(func.value)
+                return "journal" in recv.lower()
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        else:
+            return False
+        low = name.lower()
+        return low == "_journal" or (
+            "journal" in low and "append" in low
+        )
+
+    @staticmethod
+    def _actuation_label(node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        q = qualname(node.func) or ""
+        if q in _JOURNAL_ACTUATION_QUALNAMES:
+            return q
+        if isinstance(node.func, ast.Attribute) and (
+            node.func.attr in _JOURNAL_ACTUATION_ATTRS
+        ):
+            return q or node.func.attr
+        return None
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and (
+                "journal" in node.name.lower()
+            ):
+                out.extend(self._check_append_durability(ctx, node))
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, FuncNode):
+                out.extend(self._check_actuation_order(ctx, fn))
+                out.extend(self._check_snapshot_marker(ctx, fn))
+        return out
+
+    def _check_append_durability(
+        self, ctx: ModuleCtx, cls: ast.ClassDef
+    ) -> List[Finding]:
+        """Inside a *Journal* class, an ``append``/``record``/``log``
+        method that writes must fsync AT OR AFTER its last write — a
+        flush alone leaves the record in the page cache, and the caller
+        actuates the moment append returns: a crash then loses the only
+        durable evidence of an action that already happened."""
+        out = []
+        for fn in cls.body:
+            if not isinstance(fn, FuncNode):
+                continue
+            if not fn.name.lower().lstrip("_").startswith(
+                ("append", "record", "log")
+            ):
+                continue
+            writes = [
+                n
+                for n in walk_no_nested_funcs(fn)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("write", "writelines")
+            ]
+            if not writes:
+                continue
+            last_write = max(w.lineno for w in writes)
+            fsynced = any(
+                isinstance(n, ast.Call)
+                and (qualname(n.func) or "").rsplit(".", 1)[-1]
+                == "fsync"
+                and n.lineno >= last_write
+                for n in walk_no_nested_funcs(fn)
+            )
+            if not fsynced:
+                out.append(
+                    self.finding(
+                        ctx, writes[-1],
+                        "journal method %s.%s writes a record with no "
+                        "fsync after the write — the append must be "
+                        "durable BEFORE the caller actuates, or a crash "
+                        "loses the only record of an action that "
+                        "already happened (flush alone stops at the "
+                        "page cache)" % (cls.name, fn.name),
+                    )
+                )
+        return out
+
+    def _check_actuation_order(
+        self, ctx: ModuleCtx, fn
+    ) -> List[Finding]:
+        """In a function that journals AND actuates, every actuation
+        must come after the first journal append: journal-then-act can
+        at worst journal an action that never happened (replay probes
+        reality and reaps it); act-then-journal can take an action the
+        journal never heard of — the replayed controller double-spawns
+        or orphans it."""
+        appends = sorted(
+            n.lineno
+            for n in walk_no_nested_funcs(fn)
+            if self._is_journal_append(n)
+        )
+        if not appends:
+            return []
+        out = []
+        for node in walk_no_nested_funcs(fn):
+            label = self._actuation_label(node)
+            if label is None or node.lineno >= appends[0]:
+                continue
+            out.append(
+                self.finding(
+                    ctx, node,
+                    "%s runs BEFORE this function's first journal "
+                    "append (line %d) — the actuation outruns its own "
+                    "durable record, so a crash in between leaves an "
+                    "action the replayed controller never heard of "
+                    "(double-spawn / orphan on recovery); append first, "
+                    "act second" % (label, appends[0]),
+                )
+            )
+        return out
+
+    def _check_snapshot_marker(
+        self, ctx: ModuleCtx, fn
+    ) -> List[Finding]:
+        """Journal snapshot publishes — ``<helper>(base + SUFFIX, ...)``
+        atomic writes — must write the commit marker LAST: replay
+        trusts whatever a verified marker describes, so a marker
+        published before its payload describes bytes not yet on disk
+        (same contract atomic-publish pins for meta_path sidecars)."""
+        markers: List[Tuple[int, str, ast.AST]] = []
+        payloads: List[Tuple[int, str]] = []
+        for node in walk_no_nested_funcs(fn):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            q = (qualname(node.func) or "").rsplit(".", 1)[-1]
+            if q not in ("_atomic_write", "atomic_write"):
+                continue
+            path = node.args[0]
+            if not (
+                isinstance(path, ast.BinOp)
+                and isinstance(path.op, ast.Add)
+                and isinstance(path.right, ast.Name)
+            ):
+                continue
+            key = ast.dump(path.left)
+            suffix = path.right.id.lower()
+            if "marker" in suffix or "commit" in suffix:
+                markers.append((node.lineno, key, node))
+            else:
+                payloads.append((node.lineno, key))
+        out = []
+        for mline, mkey, mnode in markers:
+            if any(pk == mkey and pl > mline for pl, pk in payloads):
+                out.append(
+                    self.finding(
+                        ctx, mnode,
+                        "journal snapshot commit marker is written "
+                        "BEFORE its payload — replay trusts a verified "
+                        "marker, so it must be the LAST publish step "
+                        "(payload, fsync, then marker)",
+                    )
+                )
+        return out
+
+
 RULES = (
     JitImpurity(),
     PrngReuse(),
@@ -2286,6 +2487,7 @@ RULES = (
     LockLeak(),
     MetricNameDrift(),
     BlockingInEventLoop(),
+    JournalWriteOrdering(),
 )
 
 

@@ -245,10 +245,16 @@ class PromotionController:
         shadow_fraction: float = 0.25,
         shadow_queue: int = 64,
         registry: Optional[MetricsRegistry] = None,
+        journal=None,
     ):
         self.engine = canary_engine
         self.staging_dir = staging_dir
         self.live_dir = live_dir
+        # durable control plane: in-flight vetting + the generation
+        # counter survive a controller restart via the shared journal
+        # (serve/journal.py) — vet-begin is appended before the candidate
+        # swap, the verdict before the publish/quarantine actuation
+        self.journal = journal
         self.golden = golden
         self.budget = budget if budget is not None else CanaryBudget()
         self.name = name
@@ -279,6 +285,15 @@ class PromotionController:
         self._cond = threading.Condition()
         self.state = STAGING
         self.generation = 0
+        if journal is not None:
+            # restart-safety: resume the generation counter from the
+            # journal's vetting ledger so a relaunched controller never
+            # re-issues an already-served generation number
+            from pytorch_cifar_tpu.serve.journal import FleetJournalState
+
+            replayed = FleetJournalState.from_records(journal.records())
+            if replayed.promotion_generation is not None:
+                self.generation = int(replayed.promotion_generation)
         self.last_rejected: Optional[dict] = None
         self._seen_sig = None
         self._corrupt_sig = None
@@ -311,6 +326,13 @@ class PromotionController:
         }
 
     # -- staging signature (same scheme as the reload watcher) ----------
+
+    def _journal(self, op: str, **fields) -> None:
+        """Durably append one vetting record BEFORE the actuation it
+        describes (no-op without a journal — the pre-durable behavior)."""
+        if self.journal is not None:
+            # graftcheck: noqa[unlocked-shared-mutation] -- ControllerJournal.append serializes internally (its own mutex) and fsyncs; appending under self._cond would hold the vetting lock across disk I/O
+            self.journal.append(op, **fields)
 
     def _signature(self):
         def stat_of(path):
@@ -447,6 +469,14 @@ class PromotionController:
         with self._cond:
             self._corrupt_sig = None
         self._c_candidates.inc()
+        # in-flight vetting is journaled BEFORE the candidate touches the
+        # canary engine: a controller relaunched mid-vet knows exactly
+        # which candidate was on the bench (durable control plane)
+        self._journal(
+            "vet-begin",
+            signature=list(sig) if sig is not None else None,
+            epoch=meta.get("epoch"),
+        )
         try:
             self.engine.swap_weights(params, stats)
         except ValueError as e:
@@ -508,6 +538,7 @@ class PromotionController:
     def _promote(self, meta: dict) -> Optional[str]:
         t0 = time.perf_counter()
         sig = self._signature()
+        abandoned = False
         with self._cond:
             if sig != self._candidate_sig:
                 # the trainer republished staging AFTER this candidate
@@ -520,9 +551,24 @@ class PromotionController:
                 )
                 self.state = STAGING
                 self._token += 1
-                return None
-            gen = self.generation + 1
-            shadow_requests = self._shadow["requests"]
+                abandoned = True
+            else:
+                gen = self.generation + 1
+                shadow_requests = self._shadow["requests"]
+        if abandoned:
+            self._journal(
+                "vet-verdict", verdict="abandoned", epoch=meta.get("epoch")
+            )
+            return None
+        # the verdict is durable BEFORE the publish actuation: a relaunch
+        # between them resumes the generation counter at `gen`, never
+        # re-issuing it to a different candidate
+        self._journal(
+            "vet-verdict",
+            verdict="promoted",
+            generation=gen,
+            epoch=meta.get("epoch"),
+        )
         path = publish_checkpoint(
             self.staging_dir, self.live_dir, name=self.name,
             extra_meta={
@@ -564,6 +610,12 @@ class PromotionController:
         return PROMOTED
 
     def _reject(self, reason: str, meta: dict) -> str:
+        self._journal(
+            "vet-verdict",
+            verdict="quarantined",
+            reason=reason,
+            epoch=meta.get("epoch"),
+        )
         quarantine_checkpoint(
             self.staging_dir, self.name, reason, meta=meta,
             extra={"generation": self.generation},

@@ -43,17 +43,41 @@ interval), and the actuator is a plain callable — so the whole policy
 state machine is unit-testable with zero subprocesses and zero sleeps
 (tests/test_fleet.py).
 
+Durable control plane (SERVING.md "Durable control plane"; ROADMAP
+item 5): with a :class:`~pytorch_cifar_tpu.serve.journal.ControllerJournal`
+attached, every actuation is journaled append-durably BEFORE it is
+taken — spawn intent before the fork, replica-up before the traffic
+shift, drain intent before the deregister+SIGTERM, reap before the
+removal, plus the scaling-window/cooldown stamps and rollout state.
+:func:`recover_controller` replays the journal against live
+``/healthz`` probes: replicas that still answer (and whose pid is still
+a ``serve.py``) are re-adopted as :class:`AdoptedReplica` handles,
+dead ones are reaped-and-replaced by the ``min_replicas`` floor, and
+nothing is ever double-spawned — a controller crash stops DECISIONS,
+never the fleet. Generation-aware rolling deploys ride the same loop:
+when the live dir's promotion-generation stamp moves, the controller
+surges ONE warm replica on the new generation (gated by
+:class:`HttpGoldenGate` before it takes traffic, ``compiles==0`` via
+the shared AOT cache), then converts the fleet one replica at a time
+(spawn new, drain old) and halts + rolls back fleet-wide — restoring
+the ``.prev`` publish pair — the moment a surge canary regresses.
+
 Telemetry (OBSERVABILITY.md "elastic fleet"): ``serve.fleet.replicas``
 (gauge), ``serve.fleet.pressure`` (gauge: the per-replica load the band
-compares against), ``serve.fleet.scale_ups`` / ``serve.fleet.scale_downs``
-/ ``serve.fleet.replica_failures`` / ``serve.fleet.scrape_errors``
-(counters), ``serve.fleet.spawn_ms`` / ``serve.fleet.drain_ms``
-(histograms).
+compares against), ``serve.fleet.generation`` (gauge: the serving
+checkpoint generation), ``serve.fleet.scale_ups`` /
+``serve.fleet.scale_downs`` / ``serve.fleet.replica_failures`` /
+``serve.fleet.scrape_errors`` / ``serve.fleet.journal_replays`` /
+``serve.fleet.adoptions`` / ``serve.fleet.rollouts`` /
+``serve.fleet.rollbacks`` (counters), ``serve.fleet.spawn_ms`` /
+``serve.fleet.drain_ms`` (histograms); the journal itself counts
+``serve.fleet.journal_appends``.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import re
@@ -63,6 +87,8 @@ import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+import numpy as np
 
 from pytorch_cifar_tpu.obs import MetricsRegistry
 
@@ -249,6 +275,13 @@ class ScalingEvaluator:
         self.last_down: Optional[float] = None
         self.last_expired = 0.0
         self.last_signals: Optional[FleetSignals] = None
+
+    def observe_only(self, signals: FleetSignals) -> None:
+        """Advance the expiry baseline WITHOUT evaluating — used while a
+        rolling deploy owns actuation, so the post-deploy evaluator
+        doesn't read the whole deploy's 504 delta as fresh pressure."""
+        self.last_signals = signals
+        self.last_expired = signals.deadline_expired
 
     def evaluate(self, signals: FleetSignals, n: int, now: float):
         """One sweep's verdict. ``n`` is the managed replica count (the
@@ -494,6 +527,260 @@ def make_replica_launcher(
 
 
 # ---------------------------------------------------------------------
+# adoption + rolling-deploy building blocks (durable control plane)
+# ---------------------------------------------------------------------
+
+
+class _AdoptedProc:
+    """Minimal stand-in for the ``subprocess.Popen`` a ReplicaProcess
+    carries — launchers read ``handle.proc.returncode`` when recording a
+    fleet teardown, and an adopted replica has no Popen to ask (it was
+    reparented when its original parent died)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+
+def pid_is_serve_replica(pid) -> bool:
+    """True when ``pid`` is alive AND its command line names serve.py —
+    the pid-reuse guard adoption needs: a journal written before a crash
+    may record a pid that some unrelated process now wears. Falls back
+    to liveness-only where /proc is unavailable."""
+    if pid is None:
+        return False
+    try:
+        os.kill(int(pid), 0)
+    except (OSError, ValueError):
+        return False
+    try:
+        with open(f"/proc/{int(pid)}/cmdline", "rb") as f:
+            return b"serve.py" in f.read()
+    except OSError:
+        return True  # alive; no /proc to cross-check (non-Linux)
+
+
+class AdoptedReplica:
+    """Handle for a replica this controller did NOT spawn: a relaunched
+    controller re-adopting its predecessor's children from the journal
+    (:func:`recover_controller`). There is no Popen — the child was
+    reparented to init when the old controller died — so liveness is
+    signal 0 (plus a /proc zombie check — signal 0 succeeds on a corpse
+    the container's init never reaped) and decommission is
+    SIGTERM-by-pid with the usual SIGKILL backstop; there is no
+    ``Popen`` to ``wait()`` on.
+    Same duck type as :class:`ReplicaProcess`: ``idx``/``url``/``pid``/
+    ``health``/``generation``/``alive()``/``decommission()``."""
+
+    def __init__(self, idx, url: str, pid, *, health: Optional[dict] = None,
+                 generation=None):
+        self.idx = idx
+        self.url = url
+        self.pid = int(pid)
+        self.health: dict = dict(health or {})
+        self.generation = generation
+        self.proc = _AdoptedProc(self.pid)
+
+    def alive(self) -> bool:
+        try:
+            os.kill(self.pid, 0)
+        except OSError:
+            return False
+        # Signal 0 succeeds on a zombie. An orphan's corpse is reaped
+        # by whatever init the container runs — which may never reap —
+        # so read the state out of /proc rather than waiting out the
+        # whole decommission backstop on a process that already exited.
+        try:
+            with open(f"/proc/{self.pid}/stat", "rb") as f:
+                stat = f.read()
+            return stat[stat.rindex(b")") + 2:stat.rindex(b")") + 3] != b"Z"
+        except (OSError, ValueError):
+            return True  # no /proc: signal 0 is the best answer we have
+
+    def decommission(self, timeout_s: float = 60.0) -> float:
+        """SIGTERM (the drain signal), poll-wait, SIGKILL backstop.
+        Returns drain wall seconds, like ReplicaProcess."""
+        t0 = time.monotonic()
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except OSError:
+            return 0.0  # already gone
+        deadline = t0 + timeout_s
+        while time.monotonic() < deadline:
+            if not self.alive():
+                return time.monotonic() - t0
+            time.sleep(0.05)
+        log.warning(
+            "adopted replica %s (pid %s) ignored SIGTERM for %.0fs; "
+            "killing", self.idx, self.pid, timeout_s,
+        )
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        while self.alive():
+            time.sleep(0.05)
+        return time.monotonic() - t0
+
+
+class RemoteFleetPort:
+    """Router port for a controller operating a REMOTE data plane (the
+    split deployment: the edge process owns the real Router and follows
+    the journal via
+    :class:`~pytorch_cifar_tpu.serve.journal.JournalFollower`; this
+    controller process only journals). ``add_replica``/``remove_replica``
+    are deliberate no-ops — the durable journal append IS the membership
+    actuation, and the follower applies it — while ``fleet_view`` reads
+    the edge's live ``/healthz`` so drain-victim picking still sees real
+    in-flight counts."""
+
+    def __init__(self, fleet_url: str, timeout_s: float = 5.0):
+        self.fleet_url = fleet_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def add_replica(self, url: str) -> None:
+        return None
+
+    def remove_replica(self, url: str) -> None:
+        return None
+
+    def healthz(self) -> dict:
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                self.fleet_url + "/healthz", timeout=self.timeout_s
+            ) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            # 503 still carries the health payload
+            return json.loads(e.read().decode("utf-8"))
+
+    def fleet_view(self) -> Dict[str, tuple]:
+        """Same shape as ``Router.fleet_view``: url -> (in_flight,
+        last probed health). Empty on an unreachable edge — the
+        controller then finds no free drain victim and holds."""
+        try:
+            payload = self.healthz()
+        except (OSError, ValueError):
+            return {}
+        return {
+            rep.get("url"): (
+                int(rep.get("in_flight") or 0), rep.get("health") or {}
+            )
+            for rep in payload.get("replicas", ())
+            if rep.get("url")
+        }
+
+
+class HttpGoldenGate:
+    """The rolling deploy's canary gate: a deterministic golden batch
+    pushed through a candidate replica's OWN frontend BEFORE the router
+    shifts any traffic to it. Two checks, mirroring the promotion
+    controller's vetting shape (serve/canary.py): every logit row must
+    be finite, and — once a baseline from an old-generation replica is
+    captured — the argmax flip fraction against that baseline must stay
+    under ``max_flip_frac`` (a new generation legitimately changes SOME
+    answers; flipping most of them mid-deploy is a regression, not an
+    improvement). Returns problem strings; empty means pass."""
+
+    def __init__(self, n: int = 8, seed: int = 7, *,
+                 max_flip_frac: float = 0.75, timeout_s: float = 60.0):
+        rs = np.random.RandomState(seed)
+        self.images = rs.randint(
+            0, 256, size=(int(n), 32, 32, 3)
+        ).astype(np.uint8)
+        self.max_flip_frac = float(max_flip_frac)
+        self.timeout_s = float(timeout_s)
+        self.baseline: Optional[np.ndarray] = None
+
+    def _predict(self, url: str) -> np.ndarray:
+        from pytorch_cifar_tpu.serve.loadgen import HttpTarget
+
+        target = HttpTarget(url)
+        try:
+            return np.asarray(
+                target.submit(self.images).result(timeout=self.timeout_s)
+            )
+        finally:
+            close = getattr(target, "close", None)
+            if close is not None:
+                close()
+
+    def baseline_from(self, url: str) -> None:
+        self.baseline = self._predict(url)
+
+    def check(self, url: str) -> List[str]:
+        logits = self._predict(url)
+        problems: List[str] = []
+        finite = np.isfinite(logits).all(axis=tuple(range(1, logits.ndim)))
+        if not finite.all():
+            problems.append(
+                f"{int((~finite).sum())}/{len(finite)} golden rows "
+                "non-finite"
+            )
+            return problems
+        if self.baseline is not None and self.baseline.shape == logits.shape:
+            flips = float(
+                np.mean(
+                    np.argmax(logits, axis=-1)
+                    != np.argmax(self.baseline, axis=-1)
+                )
+            )
+            if flips > self.max_flip_frac:
+                problems.append(
+                    f"golden argmax flip fraction {flips:.2f} > "
+                    f"{self.max_flip_frac:.2f} vs old generation"
+                )
+        return problems
+
+
+def live_generation_probe(
+    ckpt_dir: str, name: str = "ckpt.msgpack"
+) -> Callable[[], Optional[int]]:
+    """The controller's rollout trigger: a callable reading the live
+    dir's promotion-generation stamp from the publish sidecar (the
+    ``promotion.generation`` the canary pipeline writes via
+    ``publish_checkpoint(extra_meta=...)``). Plain file read — no jax,
+    no checkpoint import — because the controller process never loads a
+    model. None when the sidecar is missing, torn, or unstamped."""
+    side = os.path.join(
+        ckpt_dir, os.path.splitext(name)[0] + ".json"
+    )
+
+    def probe() -> Optional[int]:
+        try:
+            with open(side) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return None
+        gen = (meta.get("promotion") or {}).get("generation")
+        return None if gen is None else int(gen)
+
+    return probe
+
+
+def live_rollback(
+    ckpt_dir: str, name: str = "ckpt.msgpack"
+) -> Callable[[], bool]:
+    """The controller's halt-and-roll-back action: republish the
+    ``.prev`` pair over the live publish (checkpoint layer's
+    ``restore_previous_publish``). Imported lazily — the checkpoint
+    module carries the jax dependency and the rollback path is the only
+    place the controller touches it."""
+
+    def rollback() -> bool:
+        from pytorch_cifar_tpu.train.checkpoint import (
+            restore_previous_publish,
+        )
+
+        return restore_previous_publish(ckpt_dir, name)
+
+    return rollback
+
+
+# ---------------------------------------------------------------------
 # the controller
 # ---------------------------------------------------------------------
 
@@ -521,6 +808,11 @@ class FleetController:
         interval_s: float = 0.5,
         clock: Callable[[], float] = time.monotonic,
         drain_timeout_s: float = 60.0,
+        journal=None,
+        generation: Optional[int] = None,
+        generation_probe: Optional[Callable[[], Optional[int]]] = None,
+        rollout_gate=None,
+        rollback: Optional[Callable[[], bool]] = None,
     ):
         self.router = router
         self.launcher = launcher
@@ -529,13 +821,27 @@ class FleetController:
         self.interval_s = float(interval_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self._clock = clock
+        # durable control plane: the actuation journal (None = memory-only,
+        # the pre-PR-17 behavior) and the rolling-deploy collaborators
+        self.journal = journal
+        self.generation = generation
+        self.generation_probe = generation_probe
+        self.rollout_gate = rollout_gate
+        self.rollback = rollback
+        self.rollout: Optional[dict] = None
+        self._last_policy_stamp = None
         self.obs = registry if registry is not None else MetricsRegistry()
         self._g_replicas = self.obs.gauge("serve.fleet.replicas")
         self._g_pressure = self.obs.gauge("serve.fleet.pressure")
+        self._g_generation = self.obs.gauge("serve.fleet.generation")
         self._c_ups = self.obs.counter("serve.fleet.scale_ups")
         self._c_downs = self.obs.counter("serve.fleet.scale_downs")
         self._c_failures = self.obs.counter("serve.fleet.replica_failures")
         self._c_scrape_errors = self.obs.counter("serve.fleet.scrape_errors")
+        self._c_replays = self.obs.counter("serve.fleet.journal_replays")
+        self._c_adoptions = self.obs.counter("serve.fleet.adoptions")
+        self._c_rollouts = self.obs.counter("serve.fleet.rollouts")
+        self._c_rollbacks = self.obs.counter("serve.fleet.rollbacks")
         self._h_spawn = self.obs.histogram("serve.fleet.spawn_ms")
         self._h_drain = self.obs.histogram("serve.fleet.drain_ms")
         # managed replicas: url -> handle. Guarded by _lock (the control
@@ -549,22 +855,90 @@ class FleetController:
         self.evaluator = ScalingEvaluator(policy)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        if generation is not None:
+            self._g_generation.set(int(generation))
+            self._journal("generation", generation=int(generation))
 
     @property
     def last_signals(self) -> Optional[FleetSignals]:
         return self.evaluator.last_signals
 
+    # -- the journal (durable before every actuation) ------------------
+
+    def _journal(self, op: str, **fields) -> None:
+        """Durably record ``op`` BEFORE the actuation it describes (the
+        append fsyncs before returning). No-op without a journal — the
+        controller then simply isn't restart-safe, as before."""
+        if self.journal is not None:
+            # graftcheck: noqa[unlocked-shared-mutation] -- ControllerJournal.append serializes internally (its own mutex) and fsyncs; taking self._lock around it would hold the control lock across disk I/O
+            self.journal.append(op, **fields)
+
+    def _journal_policy_state(self, now: float) -> None:
+        """Journal the evaluator's window/cooldown stamps whenever a
+        transition happened — translated into WALL time, because a
+        restarted controller has a fresh monotonic clock. Change-detected
+        on the raw clock values so steady state appends nothing."""
+        ev = self.evaluator
+        stamp = (ev.pressure_since, ev.idle_since, ev.last_up, ev.last_down)
+        if self.journal is None or stamp == self._last_policy_stamp:
+            return
+        with self._lock:
+            self._last_policy_stamp = stamp
+        wall = time.time()
+
+        def to_wall(t):
+            return None if t is None else wall - (now - t)
+
+        self._journal(
+            "policy",
+            pressure_since_wall=to_wall(ev.pressure_since),
+            idle_since_wall=to_wall(ev.idle_since),
+            last_up_wall=to_wall(ev.last_up),
+            last_down_wall=to_wall(ev.last_down),
+            last_expired=ev.last_expired,
+        )
+
     # -- membership ----------------------------------------------------
 
     def adopt(self, handle) -> None:
-        """Take lifecycle ownership of an already-spawned replica (the
-        launcher's seed fleet): the controller will reap it on failure
-        and may drain it on scale-down. The replica must already be in
-        the router's rotation."""
+        """Take lifecycle ownership of an already-spawned replica — the
+        launcher's seed fleet, or :func:`recover_controller`'s
+        journal-replay re-adoptions: the controller will reap it on
+        failure and may drain it on scale-down. Journaled before the
+        (idempotent) router registration, and counted."""
+        if getattr(handle, "generation", None) is None:
+            try:
+                handle.generation = (handle.health or {}).get(
+                    "promotion_generation"
+                )
+            except AttributeError:
+                pass
+        self._journal(
+            "adopt",
+            idx=int(handle.idx),
+            url=handle.url,
+            pid=getattr(handle, "pid", None),
+            generation=getattr(handle, "generation", None),
+            compiles=(getattr(handle, "health", None) or {}).get("compiles"),
+        )
+        self.router.add_replica(handle.url)
         with self._lock:
             self._replicas[handle.url] = handle
             self._next_idx = max(self._next_idx, int(handle.idx) + 1)
+        self._c_adoptions.inc()
         self._g_replicas.set(len(self.replicas()))
+
+    def seed(self, count: int) -> int:
+        """Spawn the initial fleet through the journaled spawn path
+        (sequential on purpose: the first replica fills the shared AOT
+        cache so the rest join warm). Not a scale event; prints the
+        ``==> fleet: replica i ...`` seed lines tools parse. Returns how
+        many came up."""
+        ok = 0
+        for _ in range(int(count)):
+            if self._spawn_one("seed", count=False, tag="replica") == "ok":
+                ok += 1
+        return ok
 
     def replicas(self) -> Dict[str, object]:
         with self._lock:
@@ -578,64 +952,142 @@ class FleetController:
             "scale_downs": int(self._c_downs.value),
             "replica_failures": int(self._c_failures.value),
             "scrape_errors": int(self._c_scrape_errors.value),
+            "adoptions": int(self._c_adoptions.value),
+            "rollouts": int(self._c_rollouts.value),
+            "rollbacks": int(self._c_rollbacks.value),
+            "journal_replays": int(self._c_replays.value),
+            "generation": self.generation,
         }
 
     # -- actuation -----------------------------------------------------
 
-    def _spawn_one(self, reason: str) -> bool:
-        """Launch + register one replica. Returns success. Spawn runs
-        outside the lock (it blocks for the replica's cold start — load
-        time from the warm AOT cache, compile time on a cold one)."""
+    def _spawn_one(
+        self,
+        reason: str,
+        *,
+        count: bool = True,
+        tag: str = "scale-up",
+        expect_generation: Optional[int] = None,
+    ) -> str:
+        """Launch + register one replica. Returns ``"ok"``, ``"error"``
+        (spawn failed — retryable), or ``"rejected"`` (the rollout gate
+        refused the candidate BEFORE it took traffic — the caller halts
+        the rollout). Spawn runs outside the lock (it blocks for the
+        replica's cold start — load time from the warm AOT cache,
+        compile time on a cold one). The journal sees the intent before
+        the fork and the replica-up before the traffic shift."""
         with self._lock:
             idx = self._next_idx
             self._next_idx += 1
+        self._journal(
+            "spawn-intent", idx=idx, generation=expect_generation
+        )
         t0 = self._clock()
         try:
             handle = self.launcher(idx)
         except Exception as e:
             log.warning("scale-up spawn failed (%s): %s", reason, e)
+            self._journal("spawn-failed", idx=idx, reason=str(e))
             self._c_failures.inc()
-            return False
+            return "error"
         self._h_spawn.observe((self._clock() - t0) * 1e3)
+        health = getattr(handle, "health", None) or {}
+        compiles = health.get("compiles")
+        gen = health.get("promotion_generation")
+        try:
+            handle.generation = gen
+        except AttributeError:
+            pass
+        if expect_generation is not None:
+            # the canary gate: generation + golden-batch checks against
+            # the candidate's OWN frontend, before any traffic shifts
+            problems = []
+            if gen != expect_generation:
+                problems.append(
+                    f"came up on generation {gen}, expected "
+                    f"{expect_generation}"
+                )
+            if not problems and self.rollout_gate is not None:
+                try:
+                    problems = list(self.rollout_gate.check(handle.url))
+                except Exception as e:
+                    problems = [f"gate probe failed: {e}"]
+            if problems:
+                detail = "; ".join(problems)
+                self._journal(
+                    "spawn-failed", idx=idx, reason=f"canary: {detail}"
+                )
+                print(
+                    f"==> fleet: rollout canary failed replica {idx} "
+                    f"url={handle.url} gen={gen} ({detail})",
+                    file=sys.stderr,
+                )
+                handle.decommission(self.drain_timeout_s)
+                return "rejected"
+        self._journal(
+            "replica-up",
+            idx=idx,
+            url=handle.url,
+            pid=getattr(handle, "pid", None),
+            generation=gen,
+            compiles=compiles,
+        )
         self.router.add_replica(handle.url)
         with self._lock:
             self._replicas[handle.url] = handle
             n = len(self._replicas)
-        self._c_ups.inc()
+        if count:
+            self._c_ups.inc()
         self._g_replicas.set(n)
-        compiles = (getattr(handle, "health", None) or {}).get("compiles")
         log.info(
-            "fleet scale-up (%s): replica %s url=%s compiles=%s -> %d "
-            "replicas", reason, idx, handle.url, compiles, n,
+            "fleet %s (%s): replica %s url=%s compiles=%s gen=%s -> %d "
+            "replicas", tag, reason, idx, handle.url, compiles, gen, n,
         )
-        print(
-            f"==> fleet: scale-up replica {idx} url={handle.url} "
-            f"pid={getattr(handle, 'pid', '?')} compiles={compiles} "
-            f"({reason})",
-            file=sys.stderr,
-        )
-        return True
+        if tag == "replica":
+            # the seed-fleet line order tools already parse
+            print(
+                f"==> fleet: replica {idx} "
+                f"pid={getattr(handle, 'pid', '?')} url={handle.url} "
+                f"compiles={compiles} "
+                f"aot_hits={health.get('aot_cache_hits')} gen={gen}",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"==> fleet: {tag} replica {idx} url={handle.url} "
+                f"pid={getattr(handle, 'pid', '?')} compiles={compiles} "
+                f"gen={gen} ({reason})",
+                file=sys.stderr,
+            )
+        return "ok"
 
-    def _drain_one(self, handle, count: bool = True) -> None:
+    def _drain_one(
+        self, handle, count: bool = True, tag: str = "scale-down"
+    ) -> None:
         """Deregister-then-drain one replica (never the reverse order:
         a request dispatched after the SIGTERM would race the drain).
         ``count=False`` for the shutdown path — tearing the whole fleet
-        down is not a scale event."""
+        down is not a scale event. The drain intent is journaled before
+        the deregister, the completion after the reap."""
+        self._journal(
+            "drain-intent", idx=int(handle.idx), url=handle.url
+        )
         self.router.remove_replica(handle.url)
         with self._lock:
             self._replicas.pop(handle.url, None)
             n = len(self._replicas)
         drain_s = handle.decommission(self.drain_timeout_s)
+        self._journal("drain-done", idx=int(handle.idx), url=handle.url)
         self._h_drain.observe(drain_s * 1e3)
         if count:
             self._c_downs.inc()
         self._g_replicas.set(n)
         log.info(
-            "fleet scale-down: drained %s in %.2fs -> %d replicas",
-            handle.url, drain_s, n,
+            "fleet %s: drained %s in %.2fs -> %d replicas",
+            tag, handle.url, drain_s, n,
         )
         print(
-            f"==> fleet: scale-down replica {handle.idx} "
+            f"==> fleet: {tag} replica {handle.idx} "
             f"url={handle.url} drain_s={drain_s:.2f}",
             file=sys.stderr,
         )
@@ -650,6 +1102,12 @@ class FleetController:
                 h for h in self._replicas.values() if not h.alive()
             ]
         for handle in dead:
+            self._journal(
+                "reap",
+                idx=int(handle.idx),
+                url=handle.url,
+                pid=getattr(handle, "pid", None),
+            )
             self.router.remove_replica(handle.url)
             with self._lock:
                 self._replicas.pop(handle.url, None)
@@ -670,9 +1128,11 @@ class FleetController:
     # -- the decision --------------------------------------------------
 
     def control_once(self, now: Optional[float] = None) -> str:
-        """One control sweep: reap, scrape, evaluate, actuate. Returns
-        the action taken — ``"up"``, ``"down"``, ``"replace"``
-        (min-floor refill after a replica failure), or ``"hold"``.
+        """One control sweep: reap, scrape, then either advance an
+        active rolling deploy (which owns actuation until it resolves)
+        or evaluate the scaling policy. Returns the action taken —
+        ``"up"``, ``"down"``, ``"replace"`` (min-floor refill after a
+        replica failure), ``"rollout"`` (a deploy step), or ``"hold"``.
         Deterministic given (signals, clock): the evaluator's state
         advances here and nowhere else."""
         now = self._clock() if now is None else now
@@ -685,13 +1145,33 @@ class FleetController:
             return "hold"
         self._g_pressure.set(signals.load_per_replica)
         n = len(self.replicas())
+        if self.rollout is None and self.generation_probe is not None:
+            target = self.generation_probe()
+            if target is not None and self.generation is None:
+                # first sight of a stamped publish: baseline, no deploy
+                with self._lock:
+                    self.generation = int(target)
+                self._g_generation.set(self.generation)
+                self._journal("generation", generation=self.generation)
+            elif target is not None and int(target) != self.generation:
+                self._begin_rollout(int(target), n)
+        if self.rollout is not None:
+            # a deploy in flight owns actuation; keep the expiry
+            # baseline moving so the post-rollout evaluator doesn't
+            # read the whole deploy's 504 delta as fresh pressure
+            self.evaluator.observe_only(signals)
+            result = self._rollout_step()
+            self._journal_policy_state(now)
+            return result
         action, reason = self.evaluator.evaluate(signals, n, now)
         if action == "up" and n < self.policy.max_replicas:
-            if self._spawn_one(reason):
+            if self._spawn_one(reason) == "ok":
                 self.evaluator.acted_up(now)
+                self._journal_policy_state(now)
                 return (
                     "replace" if reason == "min-replicas floor" else "up"
                 )
+            self._journal_policy_state(now)
             return "hold"
         if action == "down":
             victim = self._pick_drain_victim()
@@ -699,8 +1179,194 @@ class FleetController:
                 return "hold"  # nobody drains for free right now
             self._drain_one(victim)
             self.evaluator.acted_down(now)
+            self._journal_policy_state(now)
             return "down"
+        self._journal_policy_state(now)
         return "hold"
+
+    # -- generation-aware rolling deploys ------------------------------
+
+    def _begin_rollout(self, target: int, n: int) -> None:
+        """Arm the deploy state machine: journal the begin (before any
+        actuation), then capture the golden-batch baseline from an
+        old-generation replica while one still serves."""
+        with self._lock:
+            self.rollout = {
+                "from_generation": self.generation,
+                "to_generation": target,
+                "n_start": n,
+                "phase": "surge",
+                "reason": None,
+            }
+        self._journal(
+            "rollout-begin",
+            from_generation=self.generation,
+            to_generation=target,
+            n_start=n,
+        )
+        print(
+            f"==> fleet: rollout begin gen={self.generation} -> "
+            f"gen={target} (n={n})",
+            file=sys.stderr,
+        )
+        self._rebaseline_gate()
+
+    def _rebaseline_gate(self) -> None:
+        if self.rollout_gate is None or self.rollout is None:
+            return
+        target = self.rollout["to_generation"]
+        old = [
+            h for h in self.replicas().values()
+            if getattr(h, "generation", None) != target
+        ]
+        if not old:
+            return
+        try:
+            self.rollout_gate.baseline_from(old[0].url)
+        except Exception as e:
+            log.warning("rollout gate baseline failed: %s", e)
+
+    def _rollout_step(self) -> str:
+        """One deploy actuation per sweep: surge one gated new-generation
+        replica, then convert the fleet one replica at a time (spawn
+        new above the floor, drain old back down to it), finishing when
+        no old-generation replica remains. A rejected canary at ANY
+        spawn flips the machine into rollback: restore the ``.prev``
+        publish, drain every new-generation replica, respawn the old
+        generation back to strength."""
+        ro = self.rollout
+        target = ro["to_generation"]
+        handles = self.replicas()
+        new = [
+            h for h in handles.values()
+            if getattr(h, "generation", None) == target
+        ]
+        old = [
+            h for h in handles.values()
+            if getattr(h, "generation", None) != target
+        ]
+        n, n_start = len(handles), int(ro["n_start"] or 1)
+        if ro["phase"] == "surge":
+            if not new:
+                outcome = self._spawn_one(
+                    f"rollout surge gen {target}",
+                    count=False,
+                    tag="rollout-surge",
+                    expect_generation=target,
+                )
+                if outcome == "rejected":
+                    self._halt_rollout("surge canary rejected")
+                return "rollout"
+            ro["phase"] = "converting"
+            self._journal("rollout-phase", phase="converting")
+            return "rollout"
+        if ro["phase"] == "converting":
+            if old:
+                if n > n_start:
+                    self._drain_one(
+                        self._pick_rollout_victim(old),
+                        count=False,
+                        tag="rollout-drain",
+                    )
+                else:
+                    outcome = self._spawn_one(
+                        f"rollout gen {target}",
+                        count=False,
+                        tag="rollout-up",
+                        expect_generation=target,
+                    )
+                    if outcome == "rejected":
+                        self._halt_rollout("rollout canary rejected")
+                return "rollout"
+            self._finish_rollout()
+            return "rollout"
+        # phase == "rollback": the live dir is already restored (halt
+        # did it); unwind the new generation, then restore strength
+        if new:
+            self._drain_one(
+                self._pick_rollout_victim(new),
+                count=False,
+                tag="rollback-drain",
+            )
+            return "rollout"
+        if n < max(n_start, self.policy.min_replicas):
+            outcome = self._spawn_one(
+                f"rollback respawn gen {ro['from_generation']}",
+                count=False,
+                tag="rollback-up",
+            )
+            if outcome == "error":
+                return "rollout"  # retry next sweep
+            return "rollout"
+        self._journal(
+            "rollout-rollback-done", generation=ro["from_generation"]
+        )
+        self._c_rollbacks.inc()
+        if ro["from_generation"] is not None:
+            with self._lock:
+                self.generation = int(ro["from_generation"])
+            self._g_generation.set(self.generation)
+        print(
+            f"==> fleet: rollout rolled back to gen={self.generation} "
+            f"({ro['reason']})",
+            file=sys.stderr,
+        )
+        with self._lock:
+            self.rollout = None
+        return "rollout"
+
+    def _pick_rollout_victim(self, candidates):
+        """The deploy drain victim among ``candidates``: least
+        router-side in-flight work first (drains fastest), ties toward
+        the highest index. Unlike scale-down, a deploy MUST make
+        progress under sustained load — deregister-first means the
+        drain still answers everything already admitted."""
+        view = self.router.fleet_view()
+        return min(
+            candidates,
+            key=lambda h: (view.get(h.url, (0, {}))[0], -int(h.idx)),
+        )
+
+    def _halt_rollout(self, reason: str) -> None:
+        """Journal the halt (before the restore actuation), restore the
+        ``.prev`` publish pair so every subsequent spawn loads the old
+        generation's bits, and flip the machine into rollback."""
+        ro = self.rollout
+        self._journal("rollout-halt", reason=reason)
+        ro["phase"] = "rollback"
+        ro["reason"] = reason
+        print(
+            f"==> fleet: rollout halt gen={ro['to_generation']} "
+            f"({reason})",
+            file=sys.stderr,
+        )
+        if self.rollback is not None:
+            try:
+                restored = self.rollback()
+            except Exception:
+                log.exception("rollout rollback restore failed")
+                restored = False
+            if not restored:
+                log.warning(
+                    "rollout halt: no previous publish to restore — "
+                    "respawns will load whatever the live dir holds"
+                )
+
+    def _finish_rollout(self) -> None:
+        ro = self.rollout
+        target = int(ro["to_generation"])
+        self._journal("rollout-done", generation=target)
+        with self._lock:
+            self.generation = target
+        self._g_generation.set(target)
+        self._c_rollouts.inc()
+        print(
+            f"==> fleet: rollout done gen={target} "
+            f"(replicas={len(self.replicas())})",
+            file=sys.stderr,
+        )
+        with self._lock:
+            self.rollout = None
 
     def _pick_drain_victim(self):
         """The managed replica whose drain costs nothing: zero
@@ -764,3 +1430,146 @@ class FleetController:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+# ---------------------------------------------------------------------
+# crash recovery: replay the journal, adopt the living, reap the dead
+# ---------------------------------------------------------------------
+
+
+def probe_replica_health(url: str, timeout_s: float = 5.0) -> Optional[dict]:
+    """GET a replica's own ``/healthz``; the payload even on a 503 (a
+    degraded replica is still alive and adoptable — the reap loop deals
+    with it if it stays sick). None when unreachable/unparseable."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        try:
+            with urllib.request.urlopen(
+                url.rstrip("/") + "/healthz", timeout=timeout_s
+            ) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+
+
+def recover_controller(
+    journal,
+    router,
+    launcher: Callable[[int], object],
+    policy: FleetPolicy,
+    *,
+    scrape: Callable[[], FleetSignals],
+    probe: Callable[[str], Optional[dict]] = probe_replica_health,
+    pid_check: Callable[[object], bool] = pid_is_serve_replica,
+    **kwargs,
+) -> FleetController:
+    """Rebuild a :class:`FleetController` from its journal after a crash
+    — the "survives its own death" path. The journal is replayed to the
+    expected fleet, then every expected replica is checked against
+    reality: its ``/healthz`` must answer AND its pid must still be a
+    ``serve.py`` (the pid-reuse guard). Replicas that pass are
+    re-adopted as :class:`AdoptedReplica` handles — never re-spawned;
+    the rest are journaled as reaped and left for the ``min_replicas``
+    floor to replace. Scaling windows, cooldowns, the serving
+    generation, and an in-flight rolling deploy all resume from the
+    journal, and the replayed history is compacted down to the adopted
+    state before the loop restarts. Raises
+    :class:`~pytorch_cifar_tpu.serve.journal.JournalCorrupt` on a
+    damaged journal — recovery never guesses."""
+    from pytorch_cifar_tpu.serve.journal import FleetJournalState
+
+    state = FleetJournalState.from_records(journal.records())
+    ctl = FleetController(
+        router,
+        launcher,
+        policy,
+        scrape=scrape,
+        journal=journal,
+        generation=state.generation,
+        **kwargs,
+    )
+    ctl._c_replays.inc()
+    now_wall, now_clk = time.time(), ctl._clock()
+
+    def from_wall(w):
+        return None if w is None else now_clk - (now_wall - float(w))
+
+    ev, ps = ctl.evaluator, state.policy_state
+    ev.pressure_since = from_wall(ps.get("pressure_since_wall"))
+    ev.idle_since = from_wall(ps.get("idle_since_wall"))
+    ev.last_up = from_wall(ps.get("last_up_wall"))
+    ev.last_down = from_wall(ps.get("last_down_wall"))
+    ev.last_expired = float(ps.get("last_expired") or 0.0)
+
+    for url, info in sorted(
+        state.replicas.items(), key=lambda kv: int(kv[1].get("idx") or 0)
+    ):
+        idx, pid = info.get("idx"), info.get("pid")
+        if info.get("draining"):
+            # the crash interrupted a drain: finish it, never orphan
+            ctl._journal("drain-done", idx=idx, url=url)
+            router.remove_replica(url)
+            if pid_check(pid):
+                AdoptedReplica(idx, url, pid).decommission(
+                    ctl.drain_timeout_s
+                )
+            print(
+                f"==> fleet: recovery finished drain of replica {idx} "
+                f"url={url}",
+                file=sys.stderr,
+            )
+            continue
+        health = probe(url)
+        if health is not None and pid_check(pid):
+            handle = AdoptedReplica(
+                idx,
+                url,
+                pid,
+                health=health,
+                generation=health.get(
+                    "promotion_generation", info.get("generation")
+                ),
+            )
+            ctl.adopt(handle)  # journals the adoption, re-registers
+            print(
+                f"==> fleet: adopt replica {idx} pid={pid} url={url} "
+                f"compiles={health.get('compiles')} "
+                f"gen={handle.generation}",
+                file=sys.stderr,
+            )
+        else:
+            ctl._journal("reap", idx=idx, url=url, pid=pid)
+            router.remove_replica(url)
+            ctl._c_failures.inc()
+            print(
+                f"==> fleet: recovery reaped replica {idx} url={url} "
+                "(dead or pid reused); the floor will replace it",
+                file=sys.stderr,
+            )
+    if state.spawn_intents:
+        log.warning(
+            "journal records %d spawn intent(s) with no replica-up: a "
+            "spawn was cut down mid-launch; its child (if any) never "
+            "took traffic and exits with its warmup timeout",
+            len(state.spawn_intents),
+        )
+    if state.rollout is not None:
+        ctl.rollout = dict(state.rollout)
+        print(
+            "==> fleet: resuming rollout "
+            f"gen={ctl.rollout.get('from_generation')} -> "
+            f"gen={ctl.rollout.get('to_generation')} "
+            f"phase={ctl.rollout.get('phase')}",
+            file=sys.stderr,
+        )
+        ctl._rebaseline_gate()
+    # compact the replayed history (plus the adoption records just
+    # appended) down to a snapshot that replays to the same state
+    journal.compact(
+        FleetJournalState.from_records(journal.records()).summary_records()
+    )
+    return ctl

@@ -238,8 +238,9 @@ class Router:
         request_timeout_s: float = 60.0,
         probe_timeout_s: float = 2.0,
         transport: str = "threaded",
+        allow_empty: bool = False,
     ):
-        if not replica_urls:
+        if not replica_urls and not allow_empty:
             raise ValueError("router needs at least one replica url")
         if transport not in ("threaded", "event"):
             raise ValueError(
@@ -626,7 +627,8 @@ class Router:
     def health(self) -> dict:
         """The router's own ``/healthz`` payload: fleet status + the
         per-replica view (dispatch state + each replica's last probed
-        health), so one scrape shows the whole fleet."""
+        health, with the promotion generation surfaced top-level per
+        replica so rollout progress reads off one scrape)."""
         with self._lock:
             replicas = [
                 {
@@ -635,6 +637,9 @@ class Router:
                     "in_flight": r.in_flight,
                     "dispatched": r.dispatched,
                     "consecutive_failures": r.consecutive_failures,
+                    "generation": (r.last_health or {}).get(
+                        "promotion_generation"
+                    ),
                     "health": dict(r.last_health),
                 }
                 for r in self.replicas

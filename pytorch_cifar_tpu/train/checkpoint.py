@@ -237,6 +237,7 @@ def publish_checkpoint(
     meta = _read_meta(src_dir, name)
     payload = read_verified_payload(src_dir, name, meta)
     os.makedirs(dst_dir, exist_ok=True)
+    _preserve_previous_publish(dst_dir, name)
     out_meta = {
         "epoch": meta.get("epoch"),
         "best_acc": meta.get("best_acc"),
@@ -246,6 +247,58 @@ def publish_checkpoint(
     _atomic_write(os.path.join(dst_dir, name), payload)
     _atomic_write(meta_path(dst_dir, name), json.dumps(out_meta).encode())
     return os.path.join(dst_dir, name)
+
+
+def prev_publish_name(name: str = CKPT_NAME) -> str:
+    """On-disk name of the rollback pair kept beside the live publish:
+    the previous generation's payload, preserved by the next
+    ``publish_checkpoint``."""
+    stem, ext = os.path.splitext(name)
+    return f"{stem}.prev{ext}"
+
+
+def _preserve_previous_publish(dst_dir: str, name: str) -> None:
+    """Before overwriting a live publish, keep a VERIFIED copy of the
+    incumbent as the ``.prev`` pair — the fleet-wide rollback source for
+    generation-aware rolling deploys (SERVING.md "Durable control
+    plane"). Payload first, sidecar (the commit marker, carrying the old
+    manifest AND the old promotion-generation stamp) last, so the
+    rollback pair is itself never observably torn. A torn or corrupt
+    incumbent is not worth preserving and is skipped."""
+    if not os.path.exists(os.path.join(dst_dir, name)):
+        return
+    try:
+        prev_meta = _read_meta(dst_dir, name)
+        prev_payload = read_verified_payload(dst_dir, name, prev_meta)
+    except (OSError, ValueError, CheckpointCorrupt):
+        return
+    prev_name = prev_publish_name(name)
+    _atomic_write(os.path.join(dst_dir, prev_name), prev_payload)
+    _atomic_write(
+        meta_path(dst_dir, prev_name), json.dumps(prev_meta).encode()
+    )
+
+
+def restore_previous_publish(dst_dir: str, name: str = CKPT_NAME) -> bool:
+    """Republish the ``.prev`` rollback pair over the live publish —
+    the fleet controller's halt-and-roll-back action when a rolling
+    deploy's canary gate fails mid-rollout. Verified read (a corrupt
+    rollback source raises :class:`CheckpointCorrupt` loudly rather than
+    restoring garbage), then the usual payload-first sidecar-last
+    publish; the restored sidecar carries the OLD promotion-generation
+    stamp, so watchers and the controller's generation probe converge
+    back on the pre-rollout generation. Returns False when there is no
+    rollback pair to restore."""
+    prev_name = prev_publish_name(name)
+    if not os.path.exists(os.path.join(dst_dir, prev_name)):
+        return False
+    prev_meta = _read_meta(dst_dir, prev_name)
+    prev_payload = read_verified_payload(dst_dir, prev_name, prev_meta)
+    _atomic_write(os.path.join(dst_dir, name), prev_payload)
+    _atomic_write(
+        meta_path(dst_dir, name), json.dumps(prev_meta).encode()
+    )
+    return True
 
 
 def shard_name(name: str, index: int, num_shards: int) -> str:

@@ -92,7 +92,15 @@ def _serve_http(cfg, backend, registry) -> dict:
     print(f"==> http: serving on {frontend.url}", file=sys.stderr)
     t0 = time.perf_counter()
     stop.wait(cfg.duration_s or None)
-    print("==> http: draining", file=sys.stderr)
+    try:
+        print("==> http: draining", file=sys.stderr)
+    except OSError:
+        # Adopted orphan: the controller that spawned us (and held the
+        # read end of this pipe) is dead. The drain must not die on a
+        # progress line — frontend.stop() below is what ends the
+        # non-daemon serve threads, and skipping it leaves the process
+        # hanging in interpreter shutdown until the SIGKILL backstop.
+        pass
     frontend.stop()  # no new requests; in-flight responses finish
     elapsed = time.perf_counter() - t0
 

@@ -319,6 +319,40 @@ def test_bench_serve_elastic_mode_prints_one_json_line():
     assert rec["failed"] == 0 and rec["requests"] > 0
 
 
+def test_bench_serve_rollout_mode_prints_one_json_line():
+    """--serve-rollout (the durable control plane PR): the driver
+    contract for the rolling-deploy A/B — coordinated ROLLING-DEPLOY
+    WALL TIME (publish → whole fleet on the new generation) as the
+    headline value, the uncoordinated --replica_watch swap time and the
+    p99 observed during each deploy window riding along, and THE
+    warm-start pin: every new-generation replica the deploy spawns
+    joins with compiles == 0 from the shared AOT cache. Slow-marked
+    (conftest): it spawns two supervised fleet process trees plus a
+    training run."""
+    rec, _ = run_bench(
+        ["--serve-rollout", "--model", "LeNet", "--steps", "2"],
+        timeout=900,
+    )
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["metric"] == "serve_rollout_deploy_LeNet_cpu", rec
+    assert rec["unit"] == "seconds"
+    assert rec["value"] > 0  # publish -> fleet converged on gen 2
+    assert rec["watch_swap_s"] > 0
+    # the A/B (a ratio is a measurement, not a schema guarantee on a
+    # 1-core box — presence and positivity are)
+    assert rec["rollout_vs_watch"] > 0
+    assert rec["p99_during_rollout_ms"] > 0
+    assert rec["p99_during_watch_swap_ms"] > 0
+    # THE warm pin: the surge + every converted replica joined warm
+    assert rec["surge_compiles"] and all(
+        c == 0 for c in rec["surge_compiles"]
+    )
+    assert rec["rollouts"] == 1
+    assert rec["scale_ups"] == 0  # a deploy is not a scale event
+    assert rec["journal_seq"] > 0  # every actuation was journaled
+    assert rec["failed"] == 0 and rec["requests"] > 0
+
+
 def test_parse_child_record_skips_non_record_json_lines():
     """headline()'s child-stdout parsing (ADVICE round 5): stray brace-
     prefixed lines — dependency JSON warnings, malformed braces — must

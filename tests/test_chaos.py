@@ -251,6 +251,45 @@ def test_elastic_drill_ramp_kill_and_shed(tmp_path):
     assert rec["fleet_rc"] == 0
 
 
+def test_rollout_drill_controller_sigkill_resume_and_rollback(tmp_path):
+    """--mode rollout (SERVING.md "Durable control plane"; the ROADMAP
+    item-5 acceptance): the data plane follows the controller journal
+    while the journaled FleetController runs as a separate process.
+    Asserted: the controller is SIGKILLed mid-rolling-deploy (at the
+    gen-2 surge) under sustained load and the edge keeps serving
+    headless; the --resume relaunch re-adopts EVERY journal-live
+    replica (never double-spawns — /proc is the ground truth) and
+    finishes the conversion with every new-generation replica warm
+    (compiles == 0), zero client-visible errors, and /predict
+    bit-identical fleet-wide; a CRC-valid NaN gen-3 candidate is then
+    refused at surge (halt + .prev restore + fleet-wide rollback to the
+    gen-2 bits); and the journal replays the whole lifecycle (1
+    rollout, 1 rollback, no live replicas, no pending intents)."""
+    rec = run_chaos("rollout", tmp_path, extra=("--epochs", "2"))
+    assert rec["match"] is True
+    assert rec["killed_mid_rollout"] is True
+    assert rec["rollout_in_flight_at_kill"] is True
+    assert rec["healthy_while_headless"] >= 2
+    assert rec["resumed"] is True
+    assert rec["adoptions"] == rec["adoptable_at_kill"] >= 2
+    assert rec["no_double_spawn"] is True
+    assert rec["converted_to_gen2"] is True
+    assert rec["bit_identical_after_rollout"] is True
+    assert rec["new_gen_compiles"] and all(
+        c == "0" for c in rec["new_gen_compiles"]
+    )
+    assert rec["halted_on_nan_candidate"] is True
+    assert rec["rolled_back"] is True
+    assert rec["live_gen_after_rollback"] == 2
+    assert rec["bit_identical_after_rollback"] is True
+    # a deploy is not a scale event: the ledger stays clean
+    assert rec["rollouts"] == 1 and rec["rollbacks"] == 1
+    assert rec["scale_ups"] == 0 and rec["scale_downs"] == 0
+    assert rec["failed"] == 0 and rec["requests"] > 0
+    assert rec["orphan_pids"] == []
+    assert rec["controller_rc"] == 0
+
+
 def test_canary_drill_bad_checkpoints_contained_good_promotes(tmp_path):
     """--mode canary (ROBUSTNESS.md "canary promotion"): under sustained
     mixed-priority HTTP load, NaN'd + bitflipped + regressed checkpoints

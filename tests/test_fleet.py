@@ -48,10 +48,11 @@ class FakeReplica:
         return 0.01
 
 
-def make_fleet(policy=None, seeds=1, registry=None):
+def make_fleet(policy=None, seeds=1, registry=None, **ctl_kwargs):
     """A controller over a real (unstarted) Router, a fake launcher,
     a fake clock, and mutable scrape signals. Returns (controller,
-    clock dict, signals holder, spawned list)."""
+    clock dict, signals holder, spawned list). ``ctl_kwargs`` pass
+    through to FleetController (journal, generation_probe, ...)."""
     policy = policy or FleetPolicy(
         min_replicas=1,
         max_replicas=3,
@@ -80,6 +81,7 @@ def make_fleet(policy=None, seeds=1, registry=None):
         scrape=lambda: sig["s"],
         registry=registry or MetricsRegistry(),
         clock=lambda: clk["t"],
+        **ctl_kwargs,
     )
     for h in seed_handles:
         ctl.adopt(h)
@@ -390,3 +392,291 @@ def test_evaluator_is_pure_state_machine():
     assert ev.evaluate(pressured(2), 2, 3.0)[0] == "hold"
     # the floor verdict bypasses every window
     assert ev.evaluate(idle(0), 0, 3.1) == ("up", "min-replicas floor")
+
+
+# ---------------------------------------------------------------------
+# durable control plane: journal wiring, rolling deploys, recovery
+# ---------------------------------------------------------------------
+
+
+def _journal_ops(journal):
+    return [r["op"] for r in journal.records()]
+
+
+def make_rollout_fleet(tmp_path, seeds=2, gate=None, rollback=None,
+                       policy=None):
+    """A journaled controller whose launcher mints replicas on a
+    settable generation (``launch_gen``) and whose generation probe
+    reads a settable live generation (``probe_gen``)."""
+    from pytorch_cifar_tpu.serve.journal import ControllerJournal
+
+    policy = policy or FleetPolicy(
+        min_replicas=1, max_replicas=4, queue_high=8.0, queue_low=1.0,
+        up_after_s=2.0, down_after_s=10.0, up_cooldown_s=5.0,
+        down_cooldown_s=20.0,
+    )
+    launch_gen = {"g": 2}
+    probe_gen = {"g": 2}
+    spawned = []
+
+    def launcher(idx):
+        r = FakeReplica(idx)
+        r.health["promotion_generation"] = launch_gen["g"]
+        spawned.append(r)
+        return r
+
+    clk = {"t": 0.0}
+    sig = {"s": FleetSignals(healthy=seeds, queued=4)}  # in-band
+    journal = ControllerJournal(str(tmp_path / "fleet.journal"))
+    seed_handles = [FakeReplica(i) for i in range(seeds)]
+    for h in seed_handles:
+        h.health["promotion_generation"] = 2
+    router = Router([h.url for h in seed_handles])
+    ctl = FleetController(
+        router, launcher, policy,
+        scrape=lambda: sig["s"],
+        registry=MetricsRegistry(),
+        clock=lambda: clk["t"],
+        journal=journal,
+        generation_probe=lambda: probe_gen["g"],
+        rollout_gate=gate,
+        rollback=rollback,
+    )
+    for h in seed_handles:
+        ctl.adopt(h)
+    return ctl, clk, sig, spawned, probe_gen, launch_gen, journal
+
+
+def test_journal_records_every_actuation_in_order(tmp_path):
+    """The append-before-actuation discipline, observed end to end: the
+    journal narrates adopt → spawn-intent/replica-up → policy →
+    drain-intent/drain-done → reap in exactly the order the controller
+    acted, and the reducer over that stream matches the live fleet."""
+    from pytorch_cifar_tpu.serve.journal import (
+        ControllerJournal,
+        FleetJournalState,
+    )
+
+    journal = ControllerJournal(str(tmp_path / "j"))
+    ctl, clk, sig, spawned, seed_handles = make_fleet(journal=journal)
+    sig["s"] = pressured(1)
+    ctl.control_once(now=0.0)
+    assert ctl.control_once(now=2.0) == "up"
+    sig["s"] = idle(2)
+    ctl.control_once(now=10.0)
+    assert ctl.control_once(now=20.5) == "down"
+    spawned[0].dead = True if spawned else None
+    seed_handles[0].dead = True
+    ctl.control_once(now=21.0)  # reap + floor replace next sweeps
+    ops = _journal_ops(journal)
+    assert ops[0] == "adopt"
+    i_spawn = ops.index("spawn-intent")
+    assert ops[i_spawn + 1] == "replica-up"
+    assert "policy" in ops
+    i_drain = ops.index("drain-intent")
+    assert "drain-done" in ops[i_drain:]
+    assert "reap" in ops
+    state = FleetJournalState.from_records(journal.records())
+    assert set(state.live_replicas()) == set(ctl.replicas())
+    assert ctl.stats["journal_replays"] == 0
+    journal.close()
+
+
+def test_rolling_deploy_converts_fleet_one_at_a_time(tmp_path):
+    """The happy path: a new live generation triggers surge (one gated
+    replica above strength), then one-at-a-time conversion — never
+    below n_start — until no old-generation replica remains."""
+    ctl, clk, sig, spawned, probe_gen, launch_gen, journal = (
+        make_rollout_fleet(tmp_path)
+    )
+    assert ctl.control_once(now=0.0) == "hold"  # baselines gen=2
+    assert ctl.generation == 2
+    probe_gen["g"] = 3
+    launch_gen["g"] = 3
+    counts = []
+    actions = []
+    for i in range(1, 8):
+        actions.append(ctl.control_once(now=float(i)))
+        counts.append(len(ctl.replicas()))
+        if ctl.rollout is None and ctl.generation == 3:
+            break
+    assert ctl.generation == 3 and ctl.rollout is None
+    assert ctl.stats["rollouts"] == 1
+    assert all(a == "rollout" for a in actions)
+    # surge first (3 replicas), never below starting strength (2)
+    assert max(counts) == 3 and min(counts) >= 2
+    assert len(ctl.replicas()) == 2
+    assert all(
+        getattr(h, "generation", None) == 3
+        for h in ctl.replicas().values()
+    )
+    ops = _journal_ops(journal)
+    assert "rollout-begin" in ops and "rollout-done" in ops
+    assert ops.index("rollout-begin") < ops.index("rollout-done")
+    # scaling stayed out of it: the deploy is not a scale event
+    assert ctl.stats["scale_ups"] == 0 and ctl.stats["scale_downs"] == 0
+    journal.close()
+
+
+def test_rolling_deploy_halts_and_rolls_back_on_canary(tmp_path):
+    """A rejected canary halts the deploy BEFORE the candidate takes
+    traffic: the journal shows halt → rollback-done, the restore hook
+    runs, the fleet stays on (and returns to) the old generation at
+    full strength."""
+    class RefusingGate:
+        def __init__(self):
+            self.baselined = []
+            self.checked = []
+
+        def baseline_from(self, url):
+            self.baselined.append(url)
+
+        def check(self, url):
+            self.checked.append(url)
+            return ["golden batch: 4/8 rows flipped vs baseline"]
+
+    restored = []
+    gate = RefusingGate()
+    ctl, clk, sig, spawned, probe_gen, launch_gen, journal = (
+        make_rollout_fleet(
+            tmp_path, gate=gate, rollback=lambda: restored.append(1) or True
+        )
+    )
+    ctl.control_once(now=0.0)
+    probe_gen["g"] = 3
+    launch_gen["g"] = 3
+    assert ctl.control_once(now=1.0) == "rollout"  # surge -> rejected
+    assert gate.baselined and gate.checked  # baselined old, probed new
+    assert restored == [1]  # .prev publish restored at the halt
+    assert ctl.rollout["phase"] == "rollback"
+    # the rejected candidate never took traffic and is decommissioned
+    assert spawned[0].drained and spawned[0].url not in ctl.replicas()
+    probe_gen["g"] = 2  # the restored live dir reads old again
+    launch_gen["g"] = 2
+    assert ctl.control_once(now=2.0) == "rollout"  # rollback-done
+    assert ctl.rollout is None and ctl.generation == 2
+    assert ctl.stats["rollbacks"] == 1 and ctl.stats["rollouts"] == 0
+    assert len(ctl.replicas()) == 2  # full strength, old generation
+    ops = _journal_ops(journal)
+    assert ops.index("rollout-halt") < ops.index("rollout-rollback-done")
+    i_fail = ops.index("spawn-failed")
+    assert ops.index("spawn-intent") < i_fail < ops.index("rollout-halt")
+    journal.close()
+
+
+def test_recover_controller_adopts_live_reaps_dead_resumes_windows(
+    tmp_path,
+):
+    """The survives-its-own-death path: replaying the journal of a
+    KILLED controller re-adopts replicas that still answer /healthz
+    (never re-spawning them), reaps the dead one for the floor to
+    replace, finishes an interrupted drain, restores the cooldown
+    clocks across the wall-time translation, resumes the in-flight
+    rollout, and compacts the replayed history."""
+    import os
+    import time as _time
+
+    from pytorch_cifar_tpu.serve.fleet import recover_controller
+    from pytorch_cifar_tpu.serve.journal import ControllerJournal
+
+    path = str(tmp_path / "fleet.journal")
+    wall = _time.time()
+    j = ControllerJournal(path)
+    j.append("generation", generation=2)
+    for i in range(3):
+        j.append("spawn-intent", idx=i)
+        j.append("replica-up", idx=i, url=f"http://127.0.0.1:910{i}", pid=50 + i,
+                 generation=2, compiles=0)
+    j.append("drain-intent", idx=2, url="http://127.0.0.1:9102")  # interrupted drain
+    j.append("policy", pressure_since_wall=None, idle_since_wall=None,
+             last_up_wall=wall - 3.0, last_down_wall=None,
+             last_expired=7.0)
+    j.append("rollout-begin", from_generation=2, to_generation=3,
+             n_start=2)
+    j.append("rollout-phase", phase="converting")
+    j.close()
+
+    alive = {"http://127.0.0.1:9100"}  # u1 died with the controller; u2 was draining
+    probed = []
+
+    def probe(url):
+        probed.append(url)
+        return (
+            {"compiles": 0, "promotion_generation": 2}
+            if url in alive else None
+        )
+
+    spawned = []
+
+    def launcher(idx):
+        spawned.append(idx)
+        return FakeReplica(idx)
+
+    router = Router(["http://127.0.0.1:9100", "http://127.0.0.1:9101"])
+    journal = ControllerJournal(path)
+    clk = {"t": 100.0}
+    ctl = recover_controller(
+        journal, router, launcher,
+        FleetPolicy(min_replicas=1, max_replicas=4, queue_high=8.0,
+                    queue_low=1.0, up_after_s=2.0, down_after_s=10.0,
+                    up_cooldown_s=5.0, down_cooldown_s=20.0),
+        scrape=lambda: FleetSignals(healthy=1, queued=4),
+        probe=probe,
+        pid_check=lambda pid: pid == 50,  # only u0's pid survives
+        registry=MetricsRegistry(),
+        clock=lambda: clk["t"],
+    )
+    assert spawned == []  # recovery NEVER spawns — that's the floor's job
+    assert set(ctl.replicas()) == {"http://127.0.0.1:9100"}
+    assert ctl.replicas()["http://127.0.0.1:9100"].pid == 50
+    assert [r.url for r in router.replicas] == ["http://127.0.0.1:9100"]  # u1/u2 removed
+    assert "http://127.0.0.1:9102" not in probed  # a draining replica is finished, not probed
+    assert ctl.generation == 2
+    assert ctl.stats["journal_replays"] == 1
+    assert ctl.stats["adoptions"] == 1
+    assert ctl.stats["replica_failures"] == 1  # u1 reaped
+    # cooldown restored across the wall translation: last_up ~= now - 3
+    assert ctl.evaluator.last_up == pytest.approx(97.0, abs=2.0)
+    assert ctl.evaluator.last_expired == 7.0
+    # the interrupted rollout resumes where the journal left it
+    assert ctl.rollout["to_generation"] == 3
+    assert ctl.rollout["phase"] == "converting"
+    # the replayed history was compacted to a snapshot that still
+    # reduces to the adopted fleet
+    from pytorch_cifar_tpu.serve.journal import (
+        FleetJournalState,
+        replay_journal,
+    )
+    assert os.path.exists(path + ".snapshot.json")
+    state = FleetJournalState.from_records(replay_journal(path)[0])
+    assert set(state.live_replicas()) == {"http://127.0.0.1:9100"}
+    assert state.rollout["phase"] == "converting"
+    journal.close()
+
+
+def test_recover_controller_refuses_corrupt_journal(tmp_path):
+    from pytorch_cifar_tpu.serve.fleet import recover_controller
+    from pytorch_cifar_tpu.serve.journal import (
+        ControllerJournal,
+        JournalCorrupt,
+    )
+
+    path = tmp_path / "j"
+    j = ControllerJournal(str(path))
+    j.append("replica-up", idx=0, url="u0", pid=1, generation=1)
+    j.append("replica-up", idx=1, url="u1", pid=2, generation=1)
+    j.close()
+    lines = path.read_bytes().splitlines(keepends=True)
+    path.write_bytes(lines[0][:-9] + b"XXXXXXXX\n" + lines[1])
+    with pytest.raises(JournalCorrupt):
+        recover_controller(
+            ControllerJournal(str(path)), Router(["u0"]),
+            lambda idx: FakeReplica(idx),
+            FleetPolicy(min_replicas=1, max_replicas=2, queue_high=8.0,
+                        queue_low=1.0, up_after_s=2.0, down_after_s=10.0,
+                        up_cooldown_s=5.0, down_cooldown_s=20.0),
+            scrape=lambda: FleetSignals(healthy=1),
+            probe=lambda url: None,
+            pid_check=lambda pid: False,
+            registry=MetricsRegistry(),
+        )

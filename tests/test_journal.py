@@ -1,0 +1,295 @@
+"""Controller journal (serve/journal.py; SERVING.md "Durable control
+plane") — tier-1 unit tests.
+
+Everything here is subprocess-free and clock-free: the journal is plain
+fsync'd JSONL on a tmp_path, the reducer is pure, and the follower is
+driven through ``sync_once()`` against a fake router. The
+kill-the-controller-mid-rollout half (real processes, real /healthz)
+lives in ``tools/chaos_run.py --mode rollout`` (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import pytest
+
+from pytorch_cifar_tpu.obs import MetricsRegistry
+from pytorch_cifar_tpu.serve.journal import (
+    SNAPSHOT_MARKER_SUFFIX,
+    SNAPSHOT_SUFFIX,
+    ControllerJournal,
+    FleetJournalState,
+    JournalCorrupt,
+    JournalFollower,
+    replay_journal,
+)
+
+
+def _fill(path, n=3):
+    j = ControllerJournal(str(path))
+    for i in range(n):
+        j.append("replica-up", idx=i, url=f"http://127.0.0.1:{9000 + i}",
+                 pid=100 + i, generation=1, compiles=0)
+    j.close()
+    return j
+
+
+# ---------------------------------------------------------------------
+# wire format: append → replay, durability counters, seq continuity
+# ---------------------------------------------------------------------
+
+
+def test_append_replay_round_trip(tmp_path):
+    path = tmp_path / "j"
+    _fill(path, 3)
+    records, torn = replay_journal(str(path))
+    assert torn is False
+    assert [r["op"] for r in records] == ["replica-up"] * 3
+    assert [r["seq"] for r in records] == [1, 2, 3]
+    assert all("wall" in r for r in records)
+    # every line is a self-checking envelope: crc over the canonical body
+    with open(path) as f:
+        for line in f:
+            env = json.loads(line)
+            body = json.dumps(
+                env["rec"], sort_keys=True, separators=(",", ":")
+            ).encode("utf-8")
+            assert env["crc"] == (zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def test_append_counts_and_reopen_continues_seq(tmp_path):
+    path = tmp_path / "j"
+    reg = MetricsRegistry()
+    j = ControllerJournal(str(path), registry=reg)
+    j.append("generation", generation=1)
+    j.append("policy", last_expired=0.0)
+    assert j.seq == 2
+    j.close()
+    assert reg.counter("serve.fleet.journal_appends").value == 2
+    # a NEW journal over the same file continues the sequence — a
+    # resumed controller must never reuse a seq (replay would reject it)
+    j2 = ControllerJournal(str(path))
+    j2.append("generation", generation=2)
+    j2.close()
+    records, _ = replay_journal(str(path))
+    assert [r["seq"] for r in records] == [1, 2, 3]
+
+
+def test_missing_journal_replays_empty(tmp_path):
+    records, torn = replay_journal(str(tmp_path / "never-written"))
+    assert records == [] and torn is False
+
+
+# ---------------------------------------------------------------------
+# crash tolerance: torn tail OK, damage elsewhere = corrupt
+# ---------------------------------------------------------------------
+
+
+def test_torn_final_line_is_tolerated(tmp_path):
+    path = tmp_path / "j"
+    _fill(path, 3)
+    blob = path.read_bytes()
+    for cut in (1, 10, 25):  # progressively torn final appends
+        path.write_bytes(blob[:-cut])
+        records, torn = replay_journal(str(path))
+        assert torn is True
+        assert [r["seq"] for r in records] == [1, 2]
+
+
+def test_damage_before_the_tail_is_corrupt(tmp_path):
+    path = tmp_path / "j"
+    _fill(path, 3)
+    lines = path.read_bytes().splitlines(keepends=True)
+    # bit-flip the MIDDLE record: a crash cannot do this — refuse
+    path.write_bytes(lines[0] + lines[1][:-9] + b"XXXXXXXX\n" + lines[2])
+    with pytest.raises(JournalCorrupt):
+        replay_journal(str(path))
+    # a clean-parsing record whose seq runs BACKWARDS is also refused
+    # (somebody spliced histories)
+    j = ControllerJournal(str(tmp_path / "k"))
+    j.append("generation", generation=1)
+    j.close()
+    with open(tmp_path / "k", "ab") as f:
+        rec = {"op": "generation", "seq": 1, "wall": 0.0}
+        body = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+        f.write((json.dumps(
+            {"crc": zlib.crc32(body.encode()) & 0xFFFFFFFF, "rec": rec},
+            sort_keys=True) + "\n").encode())
+    with pytest.raises(JournalCorrupt):
+        replay_journal(str(tmp_path / "k"))
+
+
+# ---------------------------------------------------------------------
+# compaction: snapshot-then-marker, replay equivalence, bad snapshots
+# ---------------------------------------------------------------------
+
+
+def test_compact_round_trips_state_and_continues(tmp_path):
+    path = tmp_path / "j"
+    j = ControllerJournal(str(path))
+    j.append("generation", generation=2)
+    j.append("spawn-intent", idx=0, generation=None)
+    j.append("replica-up", idx=0, url="http://h:9000", pid=1,
+             generation=2, compiles=0)
+    before = FleetJournalState.from_records(j.records())
+    j.compact(before.summary_records())
+    assert os.path.exists(str(path) + SNAPSHOT_SUFFIX)
+    assert os.path.exists(str(path) + SNAPSHOT_MARKER_SUFFIX)
+    # the live file was truncated; replay = snapshot + nothing
+    after = FleetJournalState.from_records(replay_journal(str(path))[0])
+    assert after.replicas == before.replicas
+    assert after.generation == before.generation
+    assert after.next_idx == before.next_idx
+    # appends after compaction land in the (emptied) live file and
+    # replay AFTER the snapshot
+    j.append("drain-intent", idx=0, url="http://h:9000")
+    j.close()
+    final = FleetJournalState.from_records(replay_journal(str(path))[0])
+    assert final.replicas["http://h:9000"]["draining"] is True
+
+
+def test_unverifiable_snapshot_is_ignored(tmp_path):
+    path = tmp_path / "j"
+    _fill(path, 2)
+    # a marker whose payload never landed (or rotted): replay must NOT
+    # trust it — the live file is still complete, so nothing is lost
+    with open(str(path) + SNAPSHOT_SUFFIX, "w") as f:
+        f.write("not the snapshot the marker describes")
+    with open(str(path) + SNAPSHOT_MARKER_SUFFIX, "w") as f:
+        json.dump({"crc32": 1, "size": 5, "base_seq": 99}, f)
+    records, torn = replay_journal(str(path))
+    assert [r["seq"] for r in records] == [1, 2]
+
+
+# ---------------------------------------------------------------------
+# the reducer: record stream → fleet state
+# ---------------------------------------------------------------------
+
+
+def test_reducer_lifecycle_and_rollout():
+    recs = [
+        {"op": "generation", "generation": 2},
+        {"op": "spawn-intent", "idx": 0, "wall": 1.0},
+        {"op": "replica-up", "idx": 0, "url": "u0", "pid": 10,
+         "generation": 2, "compiles": 1},
+        {"op": "spawn-intent", "idx": 1, "wall": 2.0},
+        {"op": "spawn-failed", "idx": 1, "reason": "boom"},
+        {"op": "adopt", "idx": 2, "url": "u2", "pid": 12,
+         "generation": 2},
+        {"op": "policy", "last_expired": 7.0},
+        {"op": "rollout-begin", "from_generation": 2,
+         "to_generation": 3, "n_start": 2},
+        {"op": "rollout-phase", "phase": "converting"},
+        {"op": "drain-intent", "idx": 2, "url": "u2"},
+        {"op": "drain-done", "idx": 2, "url": "u2"},
+        {"op": "rollout-done", "generation": 3},
+    ]
+    s = FleetJournalState.from_records(recs)
+    assert s.generation == 3 and s.rollout is None and s.rollouts == 1
+    assert s.spawn_intents == {}  # up consumed 0; failed consumed 1
+    assert set(s.live_replicas()) == {"u0"}
+    assert s.next_idx == 3
+    assert s.policy_state["last_expired"] == 7.0
+    # an interrupted rollout stays armed with its phase
+    s2 = FleetJournalState.from_records(recs[:9])
+    assert s2.rollout["phase"] == "converting"
+    assert s2.generation == 2
+    # a halt parks the machine in rollback until rollback-done
+    s3 = FleetJournalState.from_records(
+        recs[:9] + [{"op": "rollout-halt", "reason": "canary"}]
+    )
+    assert s3.rollout["phase"] == "rollback"
+    s4 = FleetJournalState.from_records(
+        recs[:9]
+        + [{"op": "rollout-halt", "reason": "canary"},
+           {"op": "rollout-rollback-done", "generation": 2}]
+    )
+    assert s4.rollout is None and s4.rollbacks == 1
+
+
+def test_reducer_vetting_verdicts():
+    s = FleetJournalState.from_records([
+        {"op": "vet-begin", "signature": [1, 2], "epoch": 5},
+        {"op": "vet-verdict", "verdict": "promoted", "generation": 4},
+    ])
+    assert s.vetting is None and s.promotion_generation == 4
+    s = FleetJournalState.from_records([
+        {"op": "vet-begin", "signature": [1, 2], "epoch": 5},
+    ])
+    assert s.vetting is not None  # interrupted mid-vet: visible
+
+
+def test_summary_records_replay_to_same_state():
+    recs = [
+        {"op": "generation", "generation": 2},
+        {"op": "spawn-intent", "idx": 0, "wall": 1.0},
+        {"op": "replica-up", "idx": 0, "url": "u0", "pid": 10,
+         "generation": 2, "compiles": 0},
+        {"op": "policy", "last_expired": 3.0},
+        {"op": "rollout-begin", "from_generation": 2,
+         "to_generation": 3, "n_start": 1},
+    ]
+    s = FleetJournalState.from_records(recs)
+    s2 = FleetJournalState.from_records(s.summary_records())
+    assert s2.replicas == s.replicas
+    assert s2.generation == s.generation
+    assert s2.policy_state == s.policy_state
+    assert s2.rollout == s.rollout
+    assert s2.next_idx == s.next_idx
+
+
+# ---------------------------------------------------------------------
+# the follower: journal → router membership, corrupt = hold
+# ---------------------------------------------------------------------
+
+
+class FakeRouter:
+    def __init__(self):
+        self.urls = set()
+
+    def add_replica(self, url):
+        self.urls.add(url)
+
+    def remove_replica(self, url):
+        self.urls.discard(url)
+
+    def fleet_view(self):
+        return {u: (0, {}) for u in self.urls}
+
+
+def test_follower_diffs_membership(tmp_path):
+    path = tmp_path / "j"
+    j = ControllerJournal(str(path))
+    j.append("replica-up", idx=0, url="u0", pid=1, generation=1)
+    router = FakeRouter()
+    router.add_replica("stale")  # the journal never heard of it
+    f = JournalFollower(str(path), router)
+    want = f.sync_once()
+    assert set(want) == {"u0"}
+    assert router.urls == {"u0"}  # added u0, removed the stale one
+    # a drain recorded by the controller deregisters on the next poll
+    j.append("drain-intent", idx=0, url="u0")
+    f.sync_once()
+    assert router.urls == set()
+    assert f.syncs == 2
+    j.close()
+
+
+def test_follower_holds_membership_on_corrupt_journal(tmp_path):
+    path = tmp_path / "j"
+    j = ControllerJournal(str(path))
+    j.append("replica-up", idx=0, url="u0", pid=1, generation=1)
+    j.append("replica-up", idx=1, url="u1", pid=2, generation=1)
+    j.close()
+    router = FakeRouter()
+    f = JournalFollower(str(path), router)
+    f.sync_once()
+    assert router.urls == {"u0", "u1"}
+    lines = path.read_bytes().splitlines(keepends=True)
+    path.write_bytes(lines[0][:-9] + b"XXXXXXXX\n" + lines[1])
+    assert f.sync_once() == {}
+    assert router.urls == {"u0", "u1"}  # HELD: the edge keeps serving
+    assert f.corrupt_polls == 1

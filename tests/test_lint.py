@@ -72,6 +72,8 @@ def test_rule_registry_has_at_least_sixteen_rules():
         assert name in rule_names()
     # the event-loop edge PR's loop-stall rule
     assert "blocking-in-event-loop" in rule_names()
+    # the durable-control-plane PR's journal discipline rule
+    assert "journal-write-ordering" in rule_names()
 
 
 def test_suppression_requires_reason(tmp_path):
@@ -1845,3 +1847,187 @@ def test_blocking_in_event_loop_self_run_clean_and_not_vacuous():
     assert {"_on_accept", "_feed", "_begin_request",
             "_on_conn_readable"} <= names
     assert len(names) >= 20
+
+
+# ---------------------------------------------------------------------
+# journal-write-ordering (the durable control plane PR)
+# ---------------------------------------------------------------------
+
+
+def test_journal_write_ordering_append_not_durable(tmp_path):
+    """A *Journal* class whose append writes the record but never
+    fsyncs: the caller actuates the moment append returns, so a crash
+    loses the only evidence of an action that already happened. A
+    flush alone (page cache) does not count; an fsync BEFORE the write
+    does not cover it either."""
+    src = """
+    import os
+
+    class WalJournal:
+        def __init__(self, f):
+            self._f = f
+
+        def append(self, line):
+            self._f.write(line)
+            self._f.flush()  # page cache only — not durable
+
+    class EagerJournal:
+        def __init__(self, f):
+            self._f = f
+
+        def record(self, line):
+            os.fsync(self._f.fileno())  # syncs the PREVIOUS record
+            self._f.write(line)
+    """
+    found = run_rule(tmp_path, src, "journal-write-ordering")
+    assert len(found) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "WalJournal.append" in msgs
+    assert "EagerJournal.record" in msgs
+    assert "fsync" in msgs
+
+
+def test_journal_write_ordering_append_durable_is_quiet(tmp_path):
+    """write → flush → fsync (serve/journal.py's shape) is the
+    sanctioned append; non-journal classes and non-append methods are
+    out of scope."""
+    src = """
+    import os
+
+    class ControllerJournal:
+        def __init__(self, f):
+            self._f = f
+
+        def append(self, line):
+            self._f.write(line)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+        def compact(self, lines):
+            self._f.write("".join(lines))  # not an append method
+
+    class ReportWriter:  # not a journal: durability is not its contract
+        def append(self, f, line):
+            f.write(line)
+    """
+    assert run_rule(tmp_path, src, "journal-write-ordering") == []
+
+
+def test_journal_write_ordering_actuation_before_append(tmp_path):
+    """Spawning the child (or shifting traffic) BEFORE the journal
+    append that records it: a crash in between leaves an action the
+    replayed controller never heard of — the double-spawn window this
+    whole subsystem exists to close."""
+    src = """
+    import subprocess
+
+    class Controller:
+        def scale_up(self, idx, cmd):
+            proc = subprocess.Popen(cmd)  # actuation outruns the record
+            self.journal.append("spawn-intent", idx=idx)
+            return proc
+
+        def shift(self, url):
+            self.router.add_replica(url)  # traffic before the record
+            self._journal("replica-up", url=url)
+    """
+    found = run_rule(tmp_path, src, "journal-write-ordering")
+    assert len(found) == 2
+    msgs = " | ".join(f.message for f in found)
+    assert "subprocess.Popen" in msgs
+    assert "append first, act second" in msgs
+
+
+def test_journal_write_ordering_append_first_is_quiet(tmp_path):
+    """Journal-then-act is the contract; reading the journal
+    (replay_journal, .records()) is NOT an append, so recovery code
+    that replays and then actuates stays quiet."""
+    src = """
+    import os
+    import subprocess
+
+    class Controller:
+        def scale_up(self, idx, cmd):
+            self.journal.append("spawn-intent", idx=idx)
+            return subprocess.Popen(cmd)
+
+        def drain(self, handle):
+            self._journal("drain-intent", url=handle.url)
+            self.router.remove_replica(handle.url)
+            handle.decommission()
+
+    def recover(path, pids):
+        records = replay_journal(path)  # a READ: no ordering claim
+        for pid in pids:
+            os.kill(pid, 0)
+        return records
+    """
+    assert run_rule(tmp_path, src, "journal-write-ordering") == []
+
+
+def test_journal_write_ordering_marker_before_payload(tmp_path):
+    """A snapshot commit marker published before its payload describes
+    bytes not yet on disk — replay trusts a verified marker, so the
+    marker must be the LAST publish step."""
+    src = """
+    SNAP_SUFFIX = ".snapshot"
+    SNAP_MARKER_SUFFIX = ".snapshot.json"
+
+    def compact_wrong(path, payload, marker):
+        _atomic_write(path + SNAP_MARKER_SUFFIX, marker)
+        _atomic_write(path + SNAP_SUFFIX, payload)
+
+    def compact_right(path, payload, marker):
+        _atomic_write(path + SNAP_SUFFIX, payload)
+        _atomic_write(path + SNAP_MARKER_SUFFIX, marker)
+
+    def unrelated(path, marker, data):
+        # different bases: no ordering claim between them
+        _atomic_write(path + SNAP_MARKER_SUFFIX, marker)
+        _atomic_write(other(path) + SNAP_SUFFIX, data)
+    """
+    found = run_rule(tmp_path, src, "journal-write-ordering")
+    assert len(found) == 1
+    assert found[0].line < 10  # the compact_wrong marker line
+    assert "LAST publish step" in found[0].message
+
+
+def test_journal_write_ordering_self_run_clean_and_not_vacuous():
+    """The shipped journal + controller pass their own rule with ZERO
+    noqa suppressions — and not vacuously: the real fleet.py must
+    contain functions where clause (b) actually weighed a journal
+    append against an actuation."""
+    import ast as _ast
+
+    from pytorch_cifar_tpu.lint.rules import JournalWriteOrdering
+
+    serve_dir = os.path.join(PKG, "serve")
+    for fname in ("journal.py", "fleet.py", "canary.py"):
+        with open(os.path.join(serve_dir, fname)) as f:
+            assert "noqa[journal-write-ordering]" not in f.read(), fname
+    run = lint_paths(
+        [serve_dir, os.path.join(REPO, "tools")], repo_root=REPO,
+        rules=rules_by_name(["journal-write-ordering"]),
+    )
+    found = [
+        f for f in run.findings
+        if f.rule == "journal-write-ordering" and f.status == "open"
+    ]
+    assert found == [], "\n".join(f.render() for f in found)
+    # non-vacuous: the controller really has journal+actuation functions
+    with open(os.path.join(serve_dir, "fleet.py")) as f:
+        tree = _ast.parse(f.read())
+    rule = JournalWriteOrdering()
+    both = 0
+    for node in _ast.walk(tree):
+        if not isinstance(node, (_ast.FunctionDef, _ast.AsyncFunctionDef)):
+            continue
+        has_append = any(
+            rule._is_journal_append(n) for n in _ast.walk(node)
+        )
+        has_act = any(
+            rule._actuation_label(n) is not None
+            for n in _ast.walk(node)
+        )
+        both += bool(has_append and has_act)
+    assert both >= 3  # _spawn_one, _drain_one, _reap_dead at least

@@ -1105,3 +1105,80 @@ def test_ckpt_inspect_quarantine_and_staging_awareness(tmp_path):
     assert rep["quarantined"] == []
     assert rep["checkpoints"][0]["promotion_generation"] == 7
     assert rep["checkpoints"][0]["quarantined"]["active"] is False
+
+# -- tools/journal_inspect.py (controller journal verifier) --------------
+
+
+def _jinspect(journal, *extra):
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "journal_inspect.py"),
+         str(journal), *extra],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+
+
+def test_journal_inspect_replayable_torn_and_corrupt(tmp_path):
+    """The durable-control-plane contract (SERVING.md): a healthy
+    journal replays (exit 0) and the report shows exactly what a
+    resumed controller would believe — live replicas, generation, the
+    rollout in flight; a TORN final line is reported but stays exit 0
+    (replay tolerates the append racing the crash); damage anywhere
+    else is CORRUPT, exit 2."""
+    from pytorch_cifar_tpu.serve.journal import ControllerJournal
+
+    path = tmp_path / "fleet.journal"
+    j = ControllerJournal(str(path))
+    j.append("generation", generation=2)
+    j.append("spawn-intent", idx=0, generation=None)
+    j.append("replica-up", idx=0, url="http://127.0.0.1:9100",
+             pid=4242, generation=2, compiles=0)
+    j.append("spawn-intent", idx=1, generation=None)
+    j.append("replica-up", idx=1, url="http://127.0.0.1:9101",
+             pid=4243, generation=2, compiles=0)
+    j.append("drain-intent", idx=1, url="http://127.0.0.1:9101")
+    j.append("drain-done", idx=1, url="http://127.0.0.1:9101")
+    j.append("rollout-begin", from_generation=2, to_generation=3,
+             n_start=1)
+    j.close()
+
+    r = _jinspect(path, "--json")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    assert rep["corrupt"] is False and rep["torn_tail"] is False
+    assert rep["records"] == 8 and rep["last_seq"] == 8
+    assert rep["generation"] == 2
+    assert rep["live_replicas"] == ["http://127.0.0.1:9100"]
+    assert rep["replicas"]["http://127.0.0.1:9100"]["pid"] == 4242
+    assert "http://127.0.0.1:9101" not in rep["replicas"]  # drained
+    assert rep["rollout"]["to_generation"] == 3
+    assert rep["rollout"]["phase"] == "surge"
+    human = _jinspect(path)
+    assert human.returncode == 0
+    assert "REPLAYABLE" in human.stdout
+    assert "rollout IN FLIGHT: gen 2 -> 3" in human.stdout
+
+    # torn tail: the final append died mid-write — still replayable,
+    # minus that record
+    blob = path.read_bytes()
+    torn = tmp_path / "torn.journal"
+    torn.write_bytes(blob[: len(blob) - 25])
+    r = _jinspect(torn, "--json")
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    rep = json.loads(r.stdout)
+    assert rep["torn_tail"] is True and rep["records"] == 7
+    assert rep["rollout"] is None  # the torn record WAS rollout-begin
+
+    # damage a MIDDLE record: not a crash artifact — corrupt, exit 2
+    lines = blob.splitlines(keepends=True)
+    lines[2] = lines[2][:-12] + b"tampered!!!\n"
+    bad = tmp_path / "bad.journal"
+    bad.write_bytes(b"".join(lines))
+    r = _jinspect(bad, "--json")
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert json.loads(r.stdout)["corrupt"] is True
+    human = _jinspect(bad)
+    assert human.returncode == 2 and "CORRUPT" in human.stdout
+
+    # unreadable path is a usage error (exit 2, stderr message)
+    assert _jinspect(tmp_path / "nope.journal").returncode == 2

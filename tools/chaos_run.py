@@ -81,6 +81,21 @@ the same place, and prints ONE JSON line with the verdict + recovery time:
              down when the ramp ends — with ZERO client-visible
              errors in every phase, p99 bounded, and /predict
              bit-identical across every replica that ever served.
+  rollout  — durable-control-plane drill (SERVING.md "Durable control
+             plane"; the ROADMAP item-5 acceptance): the data plane
+             (router + edge, membership following the controller
+             journal) lives in the driver while the journaled
+             FleetController runs as a separate fleet_run.py child.
+             Generation 2 is published under sustained load; the
+             controller is SIGKILLed the moment its rolling deploy
+             surges, the edge must keep serving headless, and a
+             --resume relaunch must re-adopt every live replica from
+             the journal (never double-spawn — /proc is the ground
+             truth) and finish the conversion warm (surge compiles ==
+             0) with zero client-visible errors and /predict
+             bit-identical fleet-wide. A CRC-valid NaN generation-3
+             candidate must then be refused at surge: halt, .prev
+             restore, fleet-wide rollback to the gen-2 bits.
   router   — fleet drill (SERVING.md "HTTP frontend & router"): a
              2-replica fleet behind tools/router_run.py serves sustained
              mixed-priority HTTP load; one replica is SIGKILLed
@@ -738,6 +753,511 @@ def elastic_drill(args, work: str) -> dict:
         "spawn_ms_p50": rec_run["spawn_ms_p50"],
         "drain_ms_p50": rec_run["drain_ms_p50"],
         "fleet_rc": proc.returncode,
+    }
+
+
+def rollout_drill(args, work: str) -> dict:
+    """The durable-control-plane drill (SERVING.md "Durable control
+    plane"; the ROADMAP item-5 acceptance).
+
+    The deployment is SPLIT: this process hosts the data plane — a
+    Router (``allow_empty``) + HTTP frontend whose membership is driven
+    by a JournalFollower polling the controller journal — while the
+    journaled FleetController runs as a separate ``fleet_run.py --role
+    controller`` child. Killing the controller therefore stops
+    DECISIONS, never traffic.
+
+    Phases:
+      0. publish generation 1, controller #1 seeds 2 replicas through
+         the journaled spawn path; the follower surfaces them at the
+         edge. Reference /predict bits captured; sustained mixed load
+         starts and runs through EVERY later phase.
+      1. generation 2 is published under load -> the controller begins
+         a rolling deploy and surges one gated gen-2 replica (warm:
+         compiles == 0). The moment the surge line prints, the
+         controller is SIGKILLed — mid-rollout, by construction.
+      2. the edge must keep serving the mixed fleet while nobody is in
+         charge. Controller #2 relaunches with ``--resume``: it must
+         replay the journal against /healthz + pid probes, re-adopt
+         every live replica (NEVER double-spawn) and finish the
+         conversion — fleet on gen 2, zero client-visible errors,
+         /predict bit-identical on every replica.
+      3. a CRC-valid generation-3 candidate with NaN weights is
+         published (semantic regression, not bit rot — the checkpoint
+         layer cannot catch it). The rollout gate must refuse the
+         candidate at surge, halt, restore the ``.prev`` publish pair
+         (live dir back on gen 2), and roll back fleet-wide with the
+         pre-rollout bits intact.
+      4. SIGTERM drains the fleet; the journal (tools/journal_inspect)
+         must replay to the full lifecycle: 1 rollout, 1 rollback, no
+         live replicas, no orphan serve.py processes.
+    """
+    import threading
+
+    from pytorch_cifar_tpu.obs import MetricsRegistry
+    from pytorch_cifar_tpu.serve.fleet import live_generation_probe
+    from pytorch_cifar_tpu.serve.frontend import ServingFrontend
+    from pytorch_cifar_tpu.serve.journal import (
+        FleetJournalState,
+        JournalFollower,
+        replay_journal,
+    )
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+    from pytorch_cifar_tpu.serve.router import Router
+    from pytorch_cifar_tpu.train.checkpoint import (
+        payload_manifest,
+        publish_checkpoint,
+    )
+
+    src = os.path.join(work, "ckpt")
+    live = os.path.join(work, "live")
+    jpath = os.path.join(work, "fleet.journal")
+    print(f"==> [rollout] training checkpoint -> {src}", file=sys.stderr)
+    run_to_completion(train_cmd(args, src), child_env(), args.timeout)
+    publish_checkpoint(src, live, extra_meta={"promotion": {"generation": 1}})
+
+    # the data plane: built to OUTLIVE the controller (that is the whole
+    # point) — membership follows the journal, not the controller's word
+    registry = MetricsRegistry()
+    router = Router(
+        [], allow_empty=True, registry=registry, probe_s=0.2
+    ).start()
+    frontend = ServingFrontend(router, registry=registry).start()
+    follower = JournalFollower(jpath, router, poll_s=0.2).start()
+    fleet_url = frontend.url
+    print(
+        f"==> [rollout] edge serving on {fleet_url} "
+        "(membership follows the journal)", file=sys.stderr,
+    )
+
+    env = child_env()
+    env.pop("XLA_FLAGS", None)  # replicas: production 1-device shape
+
+    def controller_cmd(resume: bool):
+        cmd = [
+            sys.executable, os.path.join(REPO, "tools", "fleet_run.py"),
+            "--ckpt", live,
+            "--model", args.model,
+            "--role", "controller",
+            "--fleet_url", fleet_url,
+            "--journal", jpath,
+            "--rollouts",
+            "--min_replicas", "2",
+            "--max_replicas", "3",
+            "--buckets", "1", "4", "8",
+            "--aot_cache", os.path.join(work, "aot"),
+            "--deadline_ms", "4000",
+            "--max_wait_ms", "1",
+            "--control_interval_s", "0.25",
+            # the scaling band is parked wide open: the only actuations
+            # this drill may observe are the rolling deploy's
+            "--queue_high", "1000", "--queue_low", "0",
+            "--up_after_s", "600", "--down_after_s", "600",
+            "--up_cooldown_s", "600", "--down_cooldown_s", "600",
+        ]
+        if resume:
+            cmd.append("--resume")
+        return cmd
+
+    state_lock = threading.Lock()
+    members = {}  # idx -> {"url", "pid", "compiles", "gen", "tag"}
+    counts = {"canary_failed": 0}
+    ev = {
+        name: threading.Event()
+        for name in ("surge", "done", "halt", "rolled_back", "resumed")
+    }
+    seed_re = re.compile(
+        r"==> fleet: replica (\d+) pid=(\d+) url=(\S+) compiles=(\S+) "
+        r"aot_hits=\S+ gen=(\S+)"
+    )
+    roll_re = re.compile(
+        r"==> fleet: (rollout-surge|rollout-up|rollback-up|scale-up) "
+        r"replica (\d+) url=(\S+) pid=(\d+) compiles=(\S+) gen=(\S+)"
+    )
+
+    def watch(proc):
+        def run():
+            for line in proc.stderr:
+                sys.stderr.write(line)
+                m = seed_re.search(line)
+                if m:
+                    with state_lock:
+                        members[int(m.group(1))] = {
+                            "url": m.group(3), "pid": int(m.group(2)),
+                            "compiles": m.group(4), "gen": m.group(5),
+                            "tag": "seed",
+                        }
+                m = roll_re.search(line)
+                if m:
+                    with state_lock:
+                        members[int(m.group(2))] = {
+                            "url": m.group(3), "pid": int(m.group(4)),
+                            "compiles": m.group(5), "gen": m.group(6),
+                            "tag": m.group(1),
+                        }
+                if "rollout-surge replica" in line:
+                    ev["surge"].set()
+                if "rollout done gen=2" in line:
+                    ev["done"].set()
+                if "rollout halt gen=3" in line:
+                    ev["halt"].set()
+                if "rollout rolled back to gen=2" in line:
+                    ev["rolled_back"].set()
+                if "controller resumed from journal" in line:
+                    ev["resumed"].set()
+                if "rollout canary failed" in line:
+                    with state_lock:
+                        counts["canary_failed"] += 1
+
+        t = threading.Thread(
+            target=run, name="controller-stderr-watch", daemon=True
+        )
+        t.start()
+        return t
+
+    def healthz():
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                fleet_url + "/healthz", timeout=10
+            ) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            # 503 mid-transition: the body is still the health payload
+            return json.loads(e.read().decode("utf-8"))
+
+    def journal_state():
+        return FleetJournalState.from_records(replay_journal(jpath)[0])
+
+    def serve_pids():
+        """Live serve.py replica pids for THIS drill's live dir — the
+        ground truth the no-double-spawn claim is checked against."""
+        pids = set()
+        for d in os.listdir("/proc"):
+            if not d.isdigit():
+                continue
+            try:
+                with open(f"/proc/{d}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        "utf-8", "replace"
+                    )
+            except OSError:
+                continue  # raced an exit
+            if "serve.py" in cmd and live in cmd:
+                pids.add(int(d))
+        return pids
+
+    def teardown(*procs):
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        follower.stop()
+        frontend.stop()
+        router.stop()
+
+    # -- phase 0: controller #1 seeds the gen-1 fleet -------------------
+    print(
+        "==> [rollout] controller #1 up (seeding 2 replicas on gen 1)",
+        file=sys.stderr,
+    )
+    ctl = subprocess.Popen(
+        controller_cmd(resume=False), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, cwd=REPO,
+    )
+    watch(ctl)
+    deadline = time.monotonic() + args.timeout
+    while time.monotonic() < deadline:
+        if ctl.poll() is not None:
+            teardown(ctl)
+            raise SystemExit(
+                f"controller #1 exited rc={ctl.returncode} before the "
+                "fleet seeded"
+            )
+        if (
+            int(healthz().get("healthy_replicas", 0)) >= 2
+            and journal_state().generation == 1
+        ):
+            break
+        time.sleep(0.25)
+    else:
+        teardown(ctl)
+        raise SystemExit("timed out waiting for the seeded gen-1 fleet")
+
+    probe = np.random.RandomState(7).randint(
+        0, 256, size=(3, 32, 32, 3)
+    ).astype(np.uint8)
+    ref_bits = HttpTarget(fleet_url).submit(probe).result()
+
+    # sustained mixed load through EVERY phase, including the window
+    # where nobody is in charge
+    reports = []
+    load_stop = threading.Event()
+
+    def load_loop():
+        n = 0
+        while not load_stop.is_set():
+            n += 1
+            reports.append(run_load(
+                HttpTarget(fleet_url), clients=2,
+                requests_per_client=10**6, images_max=4,
+                seed=100 + n, duration_s=4.0,
+            ))
+
+    load_t = threading.Thread(target=load_loop, name="rollout-load")
+    load_t.start()
+
+    # -- phase 1: publish gen 2, SIGKILL the controller at the surge ----
+    print(
+        "==> [rollout] publishing generation 2 under load",
+        file=sys.stderr,
+    )
+    publish_checkpoint(src, live, extra_meta={"promotion": {"generation": 2}})
+    if not ev["surge"].wait(args.timeout):
+        load_stop.set()
+        load_t.join()
+        teardown(ctl)
+        raise SystemExit("timed out waiting for the rollout surge")
+    killed_mid_rollout = not ev["done"].is_set()
+    print(
+        f"==> [rollout] SIGKILL controller #1 (pid {ctl.pid}) at the "
+        "surge — mid-rollout", file=sys.stderr,
+    )
+    ctl.kill()
+    ctl.wait()
+
+    # -- phase 2: the edge serves on; --resume finishes the deploy ------
+    time.sleep(1.5)  # a headless window: traffic keeps flowing
+    healthy_while_dead = int(healthz().get("healthy_replicas", -1))
+    st = journal_state()
+    rollout_in_flight = st.rollout is not None
+    pids_before_resume = {
+        int(info["pid"]) for info in st.live_replicas().values()
+    }
+    with state_lock:
+        surge_urls = {
+            m["url"] for m in members.values()
+            if m["tag"] == "rollout-surge"
+        }
+
+    print(
+        "==> [rollout] relaunching the controller with --resume",
+        file=sys.stderr,
+    )
+    ctl2 = subprocess.Popen(
+        controller_cmd(resume=True), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, cwd=REPO,
+    )
+    watch(ctl2)
+    if not ev["resumed"].wait(60) or not ev["done"].wait(args.timeout):
+        load_stop.set()
+        load_t.join()
+        teardown(ctl2)
+        raise SystemExit("resumed controller never finished the rollout")
+
+    # no double-spawn: /proc ground truth == the journal's live view
+    # (drains of the old generation may still be settling — poll)
+    journal_live = journal_state().live_replicas()
+    want_pids = {int(i["pid"]) for i in journal_live.values()}
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and serve_pids() != want_pids:
+        time.sleep(0.5)
+    proc_pids = serve_pids()
+    no_double_spawn = proc_pids == want_pids
+    surge_survived = surge_urls and surge_urls <= set(journal_live)
+
+    h = healthz()
+    fleet_entries = h.get("replicas", [])
+    converted = (
+        len(fleet_entries) == 2
+        and all(r.get("generation") == 2 for r in fleet_entries)
+    )
+    identity_ok = all(
+        np.array_equal(
+            HttpTarget(r["url"]).submit(probe).result(), ref_bits
+        )
+        for r in fleet_entries
+    ) and np.array_equal(
+        HttpTarget(fleet_url).submit(probe).result(), ref_bits
+    )
+    print(
+        f"==> [rollout] fleet converted={converted} "
+        f"bits={'match' if identity_ok else 'DIVERGE'} "
+        f"pids={sorted(proc_pids)}", file=sys.stderr,
+    )
+
+    # -- phase 3: a NaN gen-3 candidate must halt + roll back -----------
+    # CRC-valid on purpose: a SEMANTIC regression the checkpoint layer
+    # cannot catch — only the rollout gate's golden batch can
+    print(
+        "==> [rollout] publishing NaN generation 3 (gate must refuse)",
+        file=sys.stderr,
+    )
+    from flax import serialization
+
+    with open(os.path.join(src, "ckpt.msgpack"), "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+
+    def poison(t):
+        if isinstance(t, dict):
+            return {k: poison(v) for k, v in t.items()}
+        a = np.asarray(t)
+        if np.issubdtype(a.dtype, np.floating):
+            return np.full_like(a, np.nan)
+        return a
+
+    payload = serialization.msgpack_serialize(poison(tree))
+    nan_dir = os.path.join(work, "nan3")
+    os.makedirs(nan_dir, exist_ok=True)
+    with open(os.path.join(nan_dir, "ckpt.msgpack"), "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    meta = dict(load_meta(src))
+    meta["manifest"] = payload_manifest(payload)
+    with open(os.path.join(nan_dir, "ckpt.json"), "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    publish_checkpoint(
+        nan_dir, live, extra_meta={"promotion": {"generation": 3}}
+    )
+    halted = ev["halt"].wait(args.timeout)
+    rolled_back = halted and ev["rolled_back"].wait(args.timeout)
+    live_gen_after = live_generation_probe(live)()
+    h = healthz()
+    still_gen2 = (
+        int(h.get("healthy_replicas", -1)) == 2
+        and all(r.get("generation") == 2 for r in h.get("replicas", []))
+    )
+    bits_after_rollback = np.array_equal(
+        HttpTarget(fleet_url).submit(probe).result(), ref_bits
+    )
+
+    # -- phase 4: drain; the journal replays the full lifecycle ---------
+    load_stop.set()
+    load_t.join()
+    print("==> [rollout] drain", file=sys.stderr)
+    ctl2.send_signal(signal.SIGTERM)
+    out, _ = ctl2.communicate(timeout=args.timeout)
+    rec_run = None
+    for ln in out.splitlines():
+        if ln.strip().startswith("{"):
+            try:
+                rec_run = json.loads(ln)
+            except ValueError:
+                continue
+    if rec_run is None:
+        teardown(ctl2)
+        raise SystemExit("fleet_run printed no JSON record")
+    ji = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "journal_inspect.py"),
+            jpath, "--json",
+        ],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    inspect_rec = (
+        json.loads(ji.stdout) if ji.returncode == 0 else {"corrupt": True}
+    )
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and serve_pids():
+        time.sleep(0.5)
+    orphans = serve_pids()
+    follower.stop()
+    frontend.stop()
+    router.stop()
+
+    with state_lock:
+        ledger = dict(members)
+        canary_failed = counts["canary_failed"]
+    new_gen_compiles = [
+        m["compiles"] for m in ledger.values()
+        if m["tag"] in ("rollout-surge", "rollout-up")
+    ]
+    total_requests = sum(r["requests"] for r in reports)
+    total_failed = sum(r["failed"] for r in reports)
+    p99_max = max((r["p99_ms"] for r in reports), default=0.0)
+
+    ok = (
+        ctl2.returncode == 0
+        and killed_mid_rollout
+        and rollout_in_flight  # the journal knew, at the kill instant
+        and healthy_while_dead >= 2  # the edge served on, headless
+        and ev["resumed"].is_set()
+        and ev["done"].is_set()
+        and halted
+        and rolled_back
+        and canary_failed >= 1
+        and rec_run["resumed"] is True
+        and rec_run["journal_replays"] == 1
+        # adopt EVERY journal-live replica, spawn none of them again
+        and rec_run["adoptions"] == len(pids_before_resume)
+        and rec_run["adoptions"] >= 2
+        and no_double_spawn
+        and surge_survived  # the adopted surge replica finished the job
+        and converted
+        and identity_ok
+        and new_gen_compiles != [] and all(
+            c == "0" for c in new_gen_compiles
+        )  # warm deploys only: the AOT cache pins surge compiles to 0
+        and rec_run["rollouts"] == 1
+        and rec_run["rollbacks"] == 1
+        and rec_run["generation"] == 2
+        # a deploy is not a scale event
+        and rec_run["scale_ups"] == 0
+        and rec_run["scale_downs"] == 0
+        and live_gen_after == 2  # the .prev pair came back fleet-wide
+        and still_gen2
+        and bits_after_rollback
+        and total_requests > 0
+        and total_failed == 0  # zero client-visible errors, all phases
+        and not inspect_rec.get("corrupt", True)
+        and inspect_rec.get("rollouts") == 1
+        and inspect_rec.get("rollbacks") == 1
+        and inspect_rec.get("live_replicas") == []
+        and inspect_rec.get("spawn_intents") == {}
+        and orphans == set()
+    )
+    return {
+        "harness": "chaos_run",
+        "mode": "rollout",
+        "match": ok,
+        "killed_mid_rollout": killed_mid_rollout,
+        "rollout_in_flight_at_kill": rollout_in_flight,
+        "healthy_while_headless": healthy_while_dead,
+        "resumed": ev["resumed"].is_set(),
+        "adoptions": rec_run["adoptions"],
+        "adoptable_at_kill": len(pids_before_resume),
+        "no_double_spawn": no_double_spawn,
+        "surge_survived": bool(surge_survived),
+        "converted_to_gen2": converted,
+        "bit_identical_after_rollout": identity_ok,
+        "new_gen_compiles": new_gen_compiles,
+        "halted_on_nan_candidate": halted,
+        "rolled_back": rolled_back,
+        "canary_failed_lines": canary_failed,
+        "live_gen_after_rollback": live_gen_after,
+        "fleet_gen2_after_rollback": still_gen2,
+        "bit_identical_after_rollback": bits_after_rollback,
+        "rollouts": rec_run["rollouts"],
+        "rollbacks": rec_run["rollbacks"],
+        "scale_ups": rec_run["scale_ups"],
+        "scale_downs": rec_run["scale_downs"],
+        "journal_replays": rec_run["journal_replays"],
+        "journal_seq": rec_run["journal_seq"],
+        "journal_inspect": {
+            k: inspect_rec.get(k)
+            for k in ("records", "rollouts", "rollbacks", "torn_tail")
+        },
+        "requests": total_requests,
+        "failed": total_failed,
+        "p99_max_ms": round(p99_max, 2),
+        "orphan_pids": sorted(orphans),
+        "controller_rc": ctl2.returncode,
     }
 
 
@@ -2143,6 +2663,7 @@ def main() -> int:
         choices=(
             "sigterm", "sigkill", "corrupt", "nan", "serve", "ckpt",
             "router", "canary", "zoo", "mesh", "elastic", "edge",
+            "rollout",
         ),
         default="sigterm",
     )
@@ -2190,7 +2711,7 @@ def main() -> int:
 
     if args.mode in (
         "serve", "ckpt", "router", "canary", "zoo", "mesh", "elastic",
-        "edge",
+        "edge", "rollout",
     ):
         record = {
             "serve": serve_drill,
@@ -2201,6 +2722,7 @@ def main() -> int:
             "mesh": mesh_drill,
             "elastic": elastic_drill,
             "edge": edge_drill,
+            "rollout": rollout_drill,
         }[args.mode](args, work)
         print(json.dumps(record))
         if record["match"] and not args.out:

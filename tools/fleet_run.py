@@ -17,22 +17,42 @@ The supervised process tree ROADMAP item 3 asks for, in one command
   nothing, replaces dead replicas to the ``--min_replicas`` floor, and
   never exceeds ``--max_replicas``.
 
+Durable control plane (SERVING.md "Durable control plane"): with
+``--journal PATH`` every actuation is journaled append-durably before it
+is taken, and ``--resume`` relaunches a crashed controller from that
+journal — re-adopting live replicas via ``/healthz`` probes instead of
+respawning them. ``--role controller --fleet_url URL`` runs ONLY the
+controller against a data plane owned by a separate edge process (a
+``tools/router_run.py``-style Router following the same journal), so the
+controller can die and return without a single dropped request.
+``--rollouts`` arms generation-aware rolling deploys: when the live
+dir's promotion-generation stamp moves, the controller surges one warm
+gated replica on the new generation, converts the fleet one replica at
+a time, and halts + rolls back fleet-wide (restoring the ``.prev``
+publish) on canary regression.
+
 Then either drives the built-in closed-loop HTTP load generator
 (``--clients > 0``) or serves until SIGTERM/SIGINT (the chaos drill's
 mode: it ramps external load 10x and SIGKILLs replicas out from under
 the controller). Prints ONE JSON record on stdout; progress and the
 machine-parseable topology lines go to stderr:
 
-    ==> fleet: replica 0 pid=123 url=http://127.0.0.1:41001 compiles=3
+    ==> fleet: replica 0 pid=123 url=http://127.0.0.1:41001 compiles=3 aot_hits=0 gen=None
     ==> fleet: serving on http://127.0.0.1:41000
-    ==> fleet: scale-up replica 2 url=... pid=... compiles=0 (load ...)
+    ==> fleet: scale-up replica 2 url=... pid=... compiles=0 gen=1 (load ...)
     ==> fleet: scale-down replica 2 url=... drain_s=0.21
+    ==> fleet: rollout begin gen=1 -> gen=2 (n=2)
+    ==> fleet: rollout-surge replica 3 url=... pid=... compiles=0 gen=2 (...)
+    ==> fleet: rollout done gen=2 (replicas=2)
 
 Usage:
   python tools/fleet_run.py --ckpt ./checkpoint --model LeNet \
       --min_replicas 1 --max_replicas 3 --aot_cache /tmp/aot
   python tools/fleet_run.py --ckpt ./checkpoint --model LeNet \
       --clients 8 --requests 64        # built-in load, then drain
+  python tools/fleet_run.py --ckpt ./checkpoint --model LeNet \
+      --role controller --fleet_url http://127.0.0.1:41000 \
+      --journal /tmp/fleet.journal --rollouts --aot_cache /tmp/aot
 
 This driver never initializes a jax backend — replicas own the devices;
 this process moves bytes and decisions.
@@ -90,6 +110,42 @@ def main() -> int:
     )
     p.add_argument("--probe_s", type=float, default=0.5)
     p.add_argument("--fail_after", type=int, default=2)
+    # durable control plane (SERVING.md "Durable control plane")
+    p.add_argument(
+        "--journal", default="",
+        help="controller journal path: every actuation is journaled "
+        "append-durably before it is taken (restart safety)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="recover from --journal: replay it against /healthz probes, "
+        "re-adopt live replicas, reap the dead — never double-spawn",
+    )
+    p.add_argument(
+        "--role", choices=("fleet", "controller"), default="fleet",
+        help="'fleet' runs router+frontend+controller in one process; "
+        "'controller' runs ONLY the journaled controller against a "
+        "remote data plane (--fleet_url) whose edge follows the journal",
+    )
+    p.add_argument(
+        "--fleet_url", default="",
+        help="the remote edge's URL (--role controller): scraped for "
+        "signals and the per-replica fleet view",
+    )
+    p.add_argument(
+        "--rollouts", action="store_true",
+        help="arm generation-aware rolling deploys keyed on the live "
+        "dir's promotion-generation stamp",
+    )
+    p.add_argument(
+        "--replica_watch", action="store_true",
+        help="spawn replicas with --watch (uncoordinated per-replica "
+        "hot-reload — the rolling-deploy BASELINE, not the default)",
+    )
+    p.add_argument(
+        "--watch_poll_s", type=float, default=0.25,
+        help="replica watcher poll period (with --replica_watch)",
+    )
     # built-in HTTP loadgen (0 clients = serve until SIGTERM/SIGINT)
     p.add_argument("--clients", type=int, default=0)
     p.add_argument("--requests", type=int, default=64)
@@ -105,17 +161,22 @@ def main() -> int:
         "the fleet frontend (SERVING.md 'Event-loop edge')",
     )
     args = p.parse_args()
+    if args.role == "controller" and not args.fleet_url:
+        p.error("--role controller requires --fleet_url")
+    if args.resume and not args.journal:
+        p.error("--resume requires --journal")
 
     from pytorch_cifar_tpu.obs import MetricsRegistry
     from pytorch_cifar_tpu.serve.fleet import (
         FleetController,
         FleetPolicy,
+        HttpGoldenGate,
+        live_generation_probe,
+        live_rollback,
         make_replica_launcher,
         scrape_fleet,
     )
-    from pytorch_cifar_tpu.serve.frontend import ServingFrontend
-    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
-    from pytorch_cifar_tpu.serve.router import Router
+    from pytorch_cifar_tpu.serve.journal import ControllerJournal
 
     policy = FleetPolicy(
         min_replicas=args.min_replicas,
@@ -128,6 +189,9 @@ def main() -> int:
         up_cooldown_s=args.up_cooldown_s,
         down_cooldown_s=args.down_cooldown_s,
     )
+    extra_args = ["--edge", args.edge]
+    if args.replica_watch:
+        extra_args += ["--watch", "--poll_s", str(args.watch_poll_s)]
     launcher = make_replica_launcher(
         args.ckpt,
         args.model,
@@ -138,19 +202,44 @@ def main() -> int:
         num_devices=args.replica_devices,
         host=args.host,
         timeout_s=args.timeout,
-        extra_args=("--edge", args.edge),
+        extra_args=tuple(extra_args),
     )
+
+    registry = MetricsRegistry()
+    journal = (
+        ControllerJournal(args.journal, registry=registry)
+        if args.journal
+        else None
+    )
+    rollout_kwargs = {}
+    if args.rollouts:
+        rollout_kwargs = dict(
+            generation_probe=live_generation_probe(args.ckpt),
+            rollout_gate=HttpGoldenGate(),
+            rollback=live_rollback(args.ckpt),
+        )
+
+    if args.role == "controller":
+        return _run_controller_role(
+            args, policy, launcher, registry, journal, rollout_kwargs
+        )
+
+    from pytorch_cifar_tpu.serve.frontend import ServingFrontend
+    from pytorch_cifar_tpu.serve.loadgen import HttpTarget, run_load
+    from pytorch_cifar_tpu.serve.router import Router
 
     # seed fleet: replica 0 alone first (it fills the AOT cache), then
     # the rest — each joining warm
     seeds = []
     for i in range(max(args.replicas, args.min_replicas)):
         replica = launcher(i)
+        replica.generation = replica.health.get("promotion_generation")
         seeds.append(replica)
         print(
             f"==> fleet: replica {i} pid={replica.pid} url={replica.url} "
             f"compiles={replica.health.get('compiles')} "
-            f"aot_hits={replica.health.get('aot_cache_hits')}",
+            f"aot_hits={replica.health.get('aot_cache_hits')} "
+            f"gen={replica.generation}",
             file=sys.stderr,
         )
 
@@ -160,7 +249,6 @@ def main() -> int:
     else:
         frontend_cls = ServingFrontend
 
-    registry = MetricsRegistry()
     router = Router(
         [r.url for r in seeds],
         registry=registry,
@@ -180,6 +268,8 @@ def main() -> int:
         scrape=lambda: scrape_fleet(frontend.url),
         registry=registry,
         interval_s=args.control_interval_s,
+        journal=journal,
+        **rollout_kwargs,
     )
     for replica in seeds:
         controller.adopt(replica)
@@ -228,16 +318,18 @@ def main() -> int:
     s = registry.summary()
     record = {
         "harness": "fleet_run",
+        "role": "fleet",
         "model": args.model,
         "min_replicas": policy.min_replicas,
         "max_replicas": policy.max_replicas,
         "fleet_url": frontend.url,
         "replicas_final": len(replicas),
         "replica_rcs": replica_rcs,
-        "scale_ups": controller.stats["scale_ups"],
-        "scale_downs": controller.stats["scale_downs"],
-        "replica_failures": controller.stats["replica_failures"],
-        "scrape_errors": controller.stats["scrape_errors"],
+        "generations": {
+            url: getattr(h, "generation", None)
+            for url, h in replicas.items()
+        },
+        **_controller_record(controller, journal),
         "spawn_ms_p50": round(s.get("serve.fleet.spawn_ms.p50", 0.0), 1),
         "drain_ms_p50": round(s.get("serve.fleet.drain_ms.p50", 0.0), 1),
         **{
@@ -245,6 +337,110 @@ def main() -> int:
             for k, v in report.items()
         },
         "router": router.stats,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def _controller_record(controller, journal) -> dict:
+    """The controller's share of the JSON record — shared by both roles
+    so drills assert the same keys either way."""
+    stats = controller.stats
+    return {
+        "scale_ups": stats["scale_ups"],
+        "scale_downs": stats["scale_downs"],
+        "replica_failures": stats["replica_failures"],
+        "scrape_errors": stats["scrape_errors"],
+        "adoptions": stats["adoptions"],
+        "rollouts": stats["rollouts"],
+        "rollbacks": stats["rollbacks"],
+        "journal_replays": stats["journal_replays"],
+        "generation": stats["generation"],
+        "journal_seq": journal.seq if journal is not None else None,
+    }
+
+
+def _run_controller_role(
+    args, policy, launcher, registry, journal, rollout_kwargs
+) -> int:
+    """The split deployment: ONLY the journaled controller. The data
+    plane (Router + frontend) lives in another process that follows the
+    same journal for membership
+    (:class:`~pytorch_cifar_tpu.serve.journal.JournalFollower`), so
+    SIGKILLing this process stops decisions — never traffic — and
+    ``--resume`` brings the decisions back."""
+    from pytorch_cifar_tpu.serve.fleet import (
+        FleetController,
+        RemoteFleetPort,
+        recover_controller,
+        scrape_fleet,
+    )
+
+    port = RemoteFleetPort(args.fleet_url)
+
+    def scrape():
+        return scrape_fleet(args.fleet_url)
+
+    if args.resume:
+        controller = recover_controller(
+            journal,
+            port,
+            launcher,
+            policy,
+            scrape=scrape,
+            registry=registry,
+            interval_s=args.control_interval_s,
+            **rollout_kwargs,
+        )
+        print(
+            f"==> fleet: controller resumed from journal "
+            f"(adopted={controller.stats['adoptions']} "
+            f"gen={controller.generation})",
+            file=sys.stderr,
+        )
+    else:
+        controller = FleetController(
+            port,
+            launcher,
+            policy,
+            scrape=scrape,
+            registry=registry,
+            interval_s=args.control_interval_s,
+            journal=journal,
+            **rollout_kwargs,
+        )
+        controller.seed(max(args.replicas, args.min_replicas))
+    controller.start()
+    print(
+        f"==> fleet: controller up (min {policy.min_replicas}, max "
+        f"{policy.max_replicas}, fleet {args.fleet_url})",
+        file=sys.stderr,
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait(args.duration_s or None)
+    finally:
+        print("==> fleet: draining", file=sys.stderr)
+        replicas = controller.replicas()
+        controller.stop(drain_replicas=True)
+
+    record = {
+        "harness": "fleet_run",
+        "role": "controller",
+        "model": args.model,
+        "min_replicas": policy.min_replicas,
+        "max_replicas": policy.max_replicas,
+        "fleet_url": args.fleet_url,
+        "replicas_final": len(replicas),
+        "generations": {
+            url: getattr(h, "generation", None)
+            for url, h in replicas.items()
+        },
+        "resumed": bool(args.resume),
+        **_controller_record(controller, journal),
     }
     print(json.dumps(record))
     return 0

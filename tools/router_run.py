@@ -22,7 +22,7 @@ Prints ONE JSON line on stdout (requests/latency percentiles + router
 hedge/eviction counters + per-replica compile counts); progress and the
 machine-parseable topology lines go to stderr:
 
-    ==> replica 0 pid=12345 url=http://127.0.0.1:41001
+    ==> replica 0 pid=12345 url=http://127.0.0.1:41001 gen=1
     ==> router: serving on http://127.0.0.1:41000
 
 Usage:
@@ -340,9 +340,10 @@ def main() -> int:
     healths = [health0] + [
         wait_healthy(r, args.timeout) for r in replicas[1:]
     ]
-    for r in replicas:
+    for r, h in zip(replicas, healths):
         print(
-            f"==> replica {r.idx} pid={r.proc.pid} url={r.url}",
+            f"==> replica {r.idx} pid={r.proc.pid} url={r.url} "
+            f"gen={h.get('promotion_generation')}",
             file=sys.stderr,
         )
 
@@ -407,6 +408,9 @@ def main() -> int:
         "replica_aot_hits": [h.get("aot_cache_hits") for h in healths],
         "replica_cold_start_s": [h.get("cold_start_s") for h in healths],
         "replica_mesh": [h.get("mesh") for h in healths],
+        "replica_generations": [
+            h.get("promotion_generation") for h in healths
+        ],
         "replica_rcs": replica_rcs,
         "follower_rcs": [
             getattr(r, "follower_rcs", []) for r in replicas
