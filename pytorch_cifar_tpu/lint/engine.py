@@ -105,10 +105,22 @@ class ModuleCtx:
             else ast.parse(source, filename=path)
         )
         self.project = project
+        self._nodes: Optional[List[ast.AST]] = None
         # line -> list of (frozenset of rule names or {"*"}, reason, raw)
         self.noqa: Dict[int, List[Tuple[frozenset, str]]] = {}
         self.noqa_problems: List[Finding] = []
         self._scan_comments()
+
+    def nodes(self) -> List[ast.AST]:
+        """Every node of ``self.tree``, flattened ONCE and shared by all
+        rules of the run. With 22 rules each re-running ``ast.walk``
+        over the full module, the walk generator machinery — not the
+        rule logic — was the biggest single cost of a whole-tree run;
+        iterating this list is the same traversal order for a fraction
+        of the time."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
 
     def _scan_comments(self) -> None:
         from pytorch_cifar_tpu.lint.rules import rule_names
@@ -223,6 +235,12 @@ class _Project:
 
     def lock_analysis(self):
         return self.graph().locks()
+
+    def exception_flow(self):
+        return self.graph().exceptions()
+
+    def fd_lifecycle(self):
+        return self.graph().fds()
 
     def metric_doc_names(self):
         """The metric names OBSERVABILITY.md's tables document, or None

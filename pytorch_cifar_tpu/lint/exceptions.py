@@ -1,0 +1,551 @@
+"""graftcheck exception-flow analysis (rules 20-21).
+
+The serve stack's three worst recent bugs were exception-escape shapes
+no AST-local rule can see: PR 16's load-shed 429 left the edge parser
+mid-state, so the NEXT keep-alive request crashed the loop callback
+with an unmapped ``TypeError`` (the client saw a silent hang, not a
+status code); PR 17's drain path raised ``BrokenPipeError`` from a
+stderr ``print`` BEFORE ``frontend.stop()``, hanging shutdown for 62s.
+Both are *flow* facts: which exceptions can reach which frames, and
+what stands between a raise and the cleanup it skips.
+
+This module computes, per function def across the whole linted tree:
+
+- **may-raise sets** — exception class names from explicit ``raise``
+  sites plus callee propagation over the PR 8 cross-module call graph,
+  filtered at every level through the enclosing ``try`` context
+  (``except``-clause narrowing, handler subsumption resolved against
+  the AST class hierarchy: repo-defined exceptions like ``QueueFull``/
+  ``UnknownModel``/``DeadlineExceeded`` AND the stdlib builtin
+  hierarchy). A handler whose body re-raises (bare ``raise`` or
+  ``raise e`` of its own asname) is *transparent* — it narrates, it
+  does not discharge.
+
+Two rule providers ride the fixpoint:
+
+- ``edge_findings_for`` (rule 20 ``unmapped-edge-exception``): an
+  exception that can escape a frontend/edge *dispatch entry* — a
+  selectors loop callback or a ``do_GET``/``do_POST`` handler in
+  ``serve/frontend.py``/``serve/edge.py`` — with no status-code
+  mapping anywhere in the handler chain. The loop's dispatch-site
+  ``except Exception: log.exception(...)`` is a crash logger, not a
+  mapping: the request gets no response and the connection wedges
+  (exactly the PR 16 ``_feed_body`` TypeError). The ``OSError``
+  family is excluded — socket errors are the loop's normal weather,
+  handled by dropping the connection.
+- ``cleanup_findings_for`` (rule 21 ``raise-before-cleanup``): on a
+  stop/close/drain-shaped path, a may-raise CALL positioned before a
+  resource-releasing call with no shared try/finally — the raise
+  skips the release (the PR 17 ``print`` → ``BrokenPipeError`` →
+  ``frontend.stop()`` never runs shape). ``print(..., file=...)`` is
+  modeled as raising ``OSError`` (a dead stderr pipe raises
+  ``BrokenPipeError`` mid-drain); guard ``raise`` statements written
+  directly in the cleanup def itself are sanctioned idiom and do not
+  count.
+
+Under-approximation is deliberate (STATIC_ANALYSIS.md "Known limits"):
+dynamic dispatch through non-``self`` receivers contributes nothing,
+and C-level raises (``int()``, ``dict[...]``, struct unpacks) are not
+modeled — the only builtin raiser in the table is ``print`` with a
+``file=`` argument. Pure stdlib ``ast``; linted code is never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from pytorch_cifar_tpu.lint.project import (
+    FuncNode,
+    ModuleInfo,
+    qualname,
+)
+
+# stdlib exception hierarchy (simple name -> direct bases), enough to
+# resolve handler subsumption for every exception this repo raises or
+# catches. Repo-defined classes are layered on top from their ClassDefs.
+_BUILTIN_BASES: Dict[str, Tuple[str, ...]] = {
+    "BaseException": (),
+    "Exception": ("BaseException",),
+    "SystemExit": ("BaseException",),
+    "KeyboardInterrupt": ("BaseException",),
+    "GeneratorExit": ("BaseException",),
+    "ArithmeticError": ("Exception",),
+    "ZeroDivisionError": ("ArithmeticError",),
+    "OverflowError": ("ArithmeticError",),
+    "FloatingPointError": ("ArithmeticError",),
+    "AssertionError": ("Exception",),
+    "AttributeError": ("Exception",),
+    "BufferError": ("Exception",),
+    "EOFError": ("Exception",),
+    "ImportError": ("Exception",),
+    "ModuleNotFoundError": ("ImportError",),
+    "LookupError": ("Exception",),
+    "IndexError": ("LookupError",),
+    "KeyError": ("LookupError",),
+    "MemoryError": ("Exception",),
+    "NameError": ("Exception",),
+    "UnboundLocalError": ("NameError",),
+    "OSError": ("Exception",),
+    "IOError": ("OSError",),
+    "BlockingIOError": ("OSError",),
+    "ChildProcessError": ("OSError",),
+    "ConnectionError": ("OSError",),
+    "BrokenPipeError": ("ConnectionError",),
+    "ConnectionAbortedError": ("ConnectionError",),
+    "ConnectionRefusedError": ("ConnectionError",),
+    "ConnectionResetError": ("ConnectionError",),
+    "FileExistsError": ("OSError",),
+    "FileNotFoundError": ("OSError",),
+    "InterruptedError": ("OSError",),
+    "IsADirectoryError": ("OSError",),
+    "NotADirectoryError": ("OSError",),
+    "PermissionError": ("OSError",),
+    "ProcessLookupError": ("OSError",),
+    "TimeoutError": ("OSError",),
+    "ReferenceError": ("Exception",),
+    "RuntimeError": ("Exception",),
+    "NotImplementedError": ("RuntimeError",),
+    "RecursionError": ("RuntimeError",),
+    "StopIteration": ("Exception",),
+    "StopAsyncIteration": ("Exception",),
+    "SyntaxError": ("Exception",),
+    "IndentationError": ("SyntaxError",),
+    "SystemError": ("Exception",),
+    "TypeError": ("Exception",),
+    "ValueError": ("Exception",),
+    "UnicodeError": ("ValueError",),
+    "UnicodeDecodeError": ("UnicodeError",),
+    "UnicodeEncodeError": ("UnicodeError",),
+}
+
+# rule 20: families an edge entry is ALLOWED to leak. OSError and kin
+# mean the socket died — the loop's answer is dropping the connection,
+# there is no client left to send a status code to. The BaseException-
+# only family is control flow, not failure.
+_EDGE_EXEMPT_ROOTS = frozenset({
+    "OSError", "SystemExit", "KeyboardInterrupt", "GeneratorExit",
+    "StopIteration",
+})
+
+# rule 21: attribute names whose call releases/retires a resource, and
+# the def-name tokens that mark a cleanup-shaped path
+_RELEASE_ATTRS = frozenset({
+    "stop", "close", "shutdown", "join", "unregister", "terminate",
+    "kill", "decommission", "disconnect",
+})
+_CLEANUP_TOKENS = frozenset({
+    "stop", "close", "drain", "shutdown", "teardown", "finish",
+    "cleanup", "exit", "quit",
+})
+_CLEANUP_EXACT = frozenset({"__exit__", "__del__", "__aexit__"})
+
+# ctx element: tuple of (handler type names, transparent?) per handler
+_Handlers = Tuple[Tuple[Tuple[str, ...], bool], ...]
+_NodeKey = Tuple[str, str]  # (abs path, def key)
+
+
+def _exc_name(expr: Optional[ast.AST]) -> Optional[str]:
+    """Simple class name of a raised/caught exception expression:
+    ``raise QueueFull(...)`` / ``raise wire.WireError`` -> the last
+    dotted segment; anything dynamic -> None."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    q = qualname(expr)
+    if q is None:
+        return None
+    return q.rsplit(".", 1)[-1]
+
+
+class ExceptionFlow:
+    """The whole-run may-raise fixpoint + the two rule providers.
+    Built lazily by ``ProjectGraph.exceptions()`` on first use by an
+    exception rule, memoized for the run."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self._built = False
+
+    # -- class hierarchy ----------------------------------------------
+
+    def _build_hierarchy(self) -> None:
+        self._bases: Dict[str, Tuple[str, ...]] = dict(_BUILTIN_BASES)
+        for m in list(self.graph.by_path.values()):
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = []
+                for b in node.bases:
+                    bq = qualname(b)
+                    if bq:
+                        bases.append(bq.rsplit(".", 1)[-1])
+                if bases:
+                    self._bases.setdefault(node.name, tuple(bases))
+
+    def ancestors(self, name: str) -> Set[str]:
+        """Transitive base-class names of ``name`` (simple names),
+        including ``name`` itself; just {name} when unknown."""
+        out: Set[str] = set()
+        work = [name]
+        while work:
+            n = work.pop()
+            if n in out:
+                continue
+            out.add(n)
+            work.extend(self._bases.get(n, ()))
+        return out
+
+    def subsumes(self, handler: str, exc: str) -> bool:
+        """Does ``except handler:`` catch an ``exc`` instance?"""
+        if handler == "BaseException":
+            return True
+        anc = self.ancestors(exc)
+        if handler == "Exception":
+            # everything is an Exception unless it roots in the
+            # BaseException-only family
+            return not (
+                {"SystemExit", "KeyboardInterrupt", "GeneratorExit"}
+                & anc
+            ) or "Exception" in anc
+        return handler in anc
+
+    # -- per-def skeletons --------------------------------------------
+
+    @staticmethod
+    def _handler_types(h: ast.ExceptHandler) -> Tuple[str, ...]:
+        if h.type is None:
+            return ("BaseException",)  # bare except
+        if isinstance(h.type, ast.Tuple):
+            names = [_exc_name(e) for e in h.type.elts]
+            return tuple(n for n in names if n) or ("BaseException",)
+        n = _exc_name(h.type)
+        return (n,) if n else ("BaseException",)
+
+    @staticmethod
+    def _handler_transparent(h: ast.ExceptHandler) -> bool:
+        """A handler that re-raises what it caught does not discharge:
+        bare ``raise`` or ``raise e`` of its own asname anywhere in the
+        handler body (nested defs excluded)."""
+        stack = list(h.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, FuncNode + (ast.Lambda,)):
+                continue
+            if isinstance(node, ast.Raise):
+                if node.exc is None:
+                    return True
+                if (
+                    h.name
+                    and isinstance(node.exc, ast.Name)
+                    and node.exc.id == h.name
+                ):
+                    return True
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    def _resolve_call(
+        self, m: ModuleInfo, key: str, fdef, q: str
+    ) -> Optional[_NodeKey]:
+        """Where a call written as ``q`` inside def ``key`` lands.
+        Calls inside nested defs are never collected here (they are
+        their own analysis units), so the enclosing scope is always
+        ``key`` itself — no parents map needed, and module-level
+        resolution is cached per (module, qualname)."""
+        if q.startswith("self."):
+            rest = q.split(".", 1)[1]
+            if "." in rest:
+                return None  # self.obj.method: type unknown
+            cls = m.cls_of.get(id(fdef))
+            if cls:
+                mk = f"{cls}.{rest}"
+                if mk in m.defs:
+                    return (m.path, mk)
+            return None
+        if "." not in q:
+            d, k = self.graph._local_def(m, key, q)
+            if d is not None:
+                return (m.path, k)
+        ck = (m.path, q)
+        if ck in self._resolve_cache:
+            return self._resolve_cache[ck]
+        r = self.graph.resolve(m, q)
+        out = (r[0].path, r[1]) if r is not None else None
+        self._resolve_cache[ck] = out
+        return out
+
+    def _collect_def(self, m: ModuleInfo, key: str, fdef) -> None:
+        """One recursive walk of ``fdef`` carrying the enclosing-try
+        context: raise sites, call sites (resolved through the project
+        graph), release calls, and try/finally coverage."""
+        nk = (m.path, key)
+        raises: List[Tuple[Tuple[str, ...], int, _Handlers]] = []
+        calls: List[tuple] = []  # (line, col, callee nk|None, printf, ctx, fins)
+        releases: List[tuple] = []  # (line, recv, attr, in_finals)
+
+        def record_call(node: ast.Call, ctx, fins, in_finals) -> None:
+            recv = None
+            attr = None
+            fq = qualname(node.func)
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                recv = qualname(node.func.value)
+            is_release = (
+                attr in _RELEASE_ATTRS
+                and recv is not None
+                # `os.path.join(...)` is string plumbing, not a thread
+                # join — a path-ish receiver never releases anything
+                and recv.rsplit(".", 1)[-1] not in ("path", "sep")
+            ) or fq == "os.close"
+            if is_release:
+                releases.append(
+                    (node.lineno, recv or "os", attr or "close", in_finals)
+                )
+            printf = False
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and any(kw.arg == "file" for kw in node.keywords)
+            ):
+                printf = True
+            callee = None
+            if fq is not None:
+                callee = self._resolve_call(m, key, fdef, fq)
+            if printf or callee is not None:
+                calls.append(
+                    (node.lineno, node.col_offset, callee, printf,
+                     ctx, fins, is_release)
+                )
+
+        def visit(node, ctx: _Handlers, fins, in_finals) -> None:
+            if isinstance(node, FuncNode + (ast.Lambda,)):
+                return  # nested defs are their own analysis units
+            if isinstance(node, ast.Try):
+                hinfo = tuple(
+                    (self._handler_types(h), self._handler_transparent(h))
+                    for h in node.handlers
+                )
+                inner = ctx + (hinfo,) if hinfo else ctx
+                tfin = fins + ((id(node),) if node.finalbody else ())
+                for s in node.body:
+                    visit(s, inner, tfin, in_finals)
+                for h in node.handlers:
+                    # a raise inside a handler is NOT caught by its own
+                    # try; the finally still covers it
+                    for s in h.body:
+                        visit(s, ctx, tfin, in_finals)
+                for s in node.orelse:
+                    # orelse runs after the body completed: the
+                    # handlers no longer apply, the finally still does
+                    visit(s, ctx, tfin, in_finals)
+                for s in node.finalbody:
+                    visit(s, ctx, fins, in_finals + (id(node),))
+                return
+            if isinstance(node, ast.Raise):
+                n = _exc_name(node.exc)
+                if n is not None:
+                    raises.append(((n,), node.lineno, ctx))
+                # bare raise: handled via handler transparency
+            if isinstance(node, ast.Call):
+                record_call(node, ctx, fins, in_finals)
+            for child in ast.iter_child_nodes(node):
+                visit(child, ctx, fins, in_finals)
+
+        for stmt in ast.iter_child_nodes(fdef):
+            visit(stmt, (), (), ())
+        self._raises[nk] = raises
+        self._calls[nk] = calls
+        self._releases[nk] = releases
+
+    # -- fixpoint ------------------------------------------------------
+
+    def _survives(self, exc: str, ctx: _Handlers) -> bool:
+        for handlers in ctx:
+            for names, transparent in handlers:
+                if transparent:
+                    continue
+                if any(self.subsumes(h, exc) for h in names):
+                    return False
+        return True
+
+    def _ensure(self) -> None:
+        if self._built:
+            return
+        self._built = True
+        self.graph._analyze()
+        self._build_hierarchy()
+        self._raises = {}
+        self._calls = {}
+        self._releases = {}
+        self._resolve_cache: Dict[Tuple[str, str], Optional[_NodeKey]] = {}
+        for m in list(self.graph.by_path.values()):
+            for key, d in m.defs.items():
+                self._collect_def(m, key, d)
+        # escaping-set fixpoint: exc name -> (origin path, key, line).
+        # Monotone grow-only over a finite name set, so it terminates;
+        # recursion cycles just stop adding.
+        esc: Dict[_NodeKey, Dict[str, Tuple[str, str, int]]] = {
+            nk: {} for nk in self._raises
+        }
+        changed = True
+        while changed:
+            changed = False
+            for nk, raises in self._raises.items():
+                cur = esc[nk]
+                for names, line, ctx in raises:
+                    for n in names:
+                        if n not in cur and self._survives(n, ctx):
+                            cur[n] = (nk[0], nk[1], line)
+                            changed = True
+                for line, _c, callee, printf, ctx, _f, _r in self._calls[nk]:
+                    if printf and "OSError" not in cur and self._survives(
+                        "OSError", ctx
+                    ):
+                        cur["OSError"] = (nk[0], nk[1], line)
+                        changed = True
+                    if callee is None:
+                        continue
+                    for n, origin in esc.get(callee, {}).items():
+                        if n not in cur and self._survives(n, ctx):
+                            cur[n] = origin
+                            changed = True
+        self._esc = esc
+
+    def may_raise(self, path: str, key: str) -> Dict[str, Tuple[str, str, int]]:
+        """{escaping exception name: (origin path, def key, line)} for
+        the def ``key`` in the module at ``path``."""
+        self._ensure()
+        return dict(self._esc.get((os.path.abspath(path), key), {}))
+
+    # -- rule 20: unmapped-edge-exception ------------------------------
+
+    @staticmethod
+    def _is_edge_module(path: str) -> bool:
+        p = os.path.abspath(path).replace(os.sep, "/")
+        return p.endswith("serve/frontend.py") or p.endswith(
+            "serve/edge.py"
+        )
+
+    def dispatch_entries_for(self, path: str) -> Dict[str, str]:
+        """{def key: entry label} — the dispatch entries of an edge
+        module: selectors loop callbacks registered anywhere in the
+        tree that resolve to defs in this module, plus ``do_GET``/
+        ``do_POST``-style handler methods."""
+        self._ensure()
+        ap = os.path.abspath(path)
+        out: Dict[str, str] = {}
+        if not self._is_edge_module(ap):
+            return out
+        for epath, ekey, label in self.graph._loop_entries:
+            if epath == ap:
+                out.setdefault(ekey, label)
+        m = self.graph.by_path.get(ap)
+        if m is not None:
+            for key in m.defs:
+                base = key.rsplit(".", 1)[-1]
+                if base in ("do_GET", "do_POST", "do_PUT", "do_DELETE"):
+                    out.setdefault(key, f"{m.name}:{key}")
+        return out
+
+    def entry_closure_keys(self, path: str) -> Set[str]:
+        """Def keys in ``path`` reachable from its dispatch entries —
+        what rule 20 actually analyzed (the non-vacuity pin)."""
+        self._ensure()
+        ap = os.path.abspath(path)
+        seeds = {(ap, k) for k in self.dispatch_entries_for(ap)}
+        return {nk[1] for nk in self.graph._closure(seeds) if nk[0] == ap}
+
+    def edge_findings_for(self, path: str) -> List[Tuple[int, int, str]]:
+        self._ensure()
+        ap = os.path.abspath(path)
+        out: List[Tuple[int, int, str]] = []
+        entries = self.dispatch_entries_for(ap)
+        for key in sorted(entries):
+            node = self.graph._node_of.get((ap, key))
+            if node is None:
+                continue
+            for exc, origin in sorted(self._esc.get((ap, key), {}).items()):
+                if self.ancestors(exc) & _EDGE_EXEMPT_ROOTS:
+                    continue
+                opath, okey, oline = origin
+                where = (
+                    f"line {oline}" if opath == ap and okey == key
+                    else f"{os.path.basename(opath)}:{oline} in {okey!r}"
+                )
+                out.append((
+                    node.lineno, node.col_offset,
+                    f"{exc} (raised at {where}) can escape the edge "
+                    f"dispatch entry {key!r} with no status-code "
+                    f"mapping in the handler chain — the client gets "
+                    f"a wedged connection instead of an error "
+                    f"response (the PR 16 _feed_body TypeError "
+                    f"shape); catch it where a status can still be "
+                    f"sent, or map it explicitly",
+                ))
+        return out
+
+    # -- rule 21: raise-before-cleanup ---------------------------------
+
+    @staticmethod
+    def _is_cleanup_def(key: str) -> bool:
+        base = key.rsplit(".", 1)[-1]
+        if base in _CLEANUP_EXACT:
+            return True
+        parts = {p for p in base.lower().split("_") if p}
+        return bool(parts & _CLEANUP_TOKENS)
+
+    def cleanup_findings_for(self, path: str) -> List[Tuple[int, int, str]]:
+        self._ensure()
+        ap = os.path.abspath(path)
+        out: List[Tuple[int, int, str]] = []
+        for nk in sorted(k for k in self._raises if k[0] == ap):
+            key = nk[1]
+            releases = self._releases.get(nk, ())
+            if not releases:
+                continue
+            # gate: only defs that ARE a cleanup path by name. A long
+            # main() also ends in releases, but a raise mid-setup dying
+            # before teardown is process-exit territory — flagging every
+            # banner print in every tool main is cry-wolf, and the rule
+            # would get turned off (the PR 5 discipline)
+            if not self._is_cleanup_def(key):
+                continue
+            for line, col, callee, printf, ctx, fins, rel in self._calls[nk]:
+                if rel:
+                    # a release call is the thing being skipped, not
+                    # the thing doing the skipping
+                    continue
+                excs: Dict[str, Tuple[str, str, int]] = {}
+                if printf and self._survives("OSError", ctx):
+                    excs["OSError"] = (nk[0], key, line)
+                if callee is not None:
+                    for n, origin in self._esc.get(callee, {}).items():
+                        if self._survives(n, ctx):
+                            excs.setdefault(n, origin)
+                if not excs:
+                    continue
+                skipped = None
+                for rline, recv, attr, in_finals in releases:
+                    if rline <= line:
+                        continue
+                    if any(t in fins for t in in_finals):
+                        continue  # shared try/finally: release runs
+                    skipped = (rline, recv, attr)
+                    break
+                if skipped is None:
+                    continue
+                rline, recv, attr = skipped
+                names = ", ".join(sorted(excs))
+                out.append((
+                    line, col,
+                    f"this call may raise {names} before "
+                    f"{recv}.{attr}() at line {rline} on the cleanup "
+                    f"path {key!r} — the raise skips the release and "
+                    f"the resource is never retired (the PR 17 drain "
+                    f"BrokenPipeError-before-frontend.stop() shape); "
+                    f"move the release into a try/finally or catch "
+                    f"{names} around this call",
+                ))
+        return out

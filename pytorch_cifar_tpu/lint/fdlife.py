@@ -1,0 +1,352 @@
+"""graftcheck fd/socket lifecycle analysis (rule 22).
+
+Rule 17 ``subprocess-lifecycle`` proved the shape: an acquired handle
+must be released on some path, be scope-managed, or be handed to an
+owner that releases it — everything else is a leak the process pays
+for later. PR 16 paid it with a socket: the ``Connection: close``
+path dropped an accepted connection without closing it, and the edge
+bled one fd per shed client. This module generalizes the escape
+analysis from ``Popen`` to every fd-holding acquisition the serve
+stack uses:
+
+- ``socket.socket(...)`` / ``socket.create_connection(...)`` and the
+  ``conn, addr = sock.accept()`` unpack (in socket-importing modules);
+- ``os.pipe()`` (both ends tracked through the tuple unpack) and
+  ``os.open(...)``, released by ``os.close(fd)``;
+- builtin ``open(...)`` bound by plain assignment (``with open(...)
+  as f`` is scope-managed and never tracked);
+- ``selectors.DefaultSelector()`` — plus a module-coarse registration
+  check: a module that ``register``\\ s fileobjs on a selector it owns
+  must somewhere ``unregister`` or ``close`` that selector.
+
+Discharge mirrors rule 17 exactly:
+
+- **function-local**: ``x.close()``/``x.detach()``/``os.close(x)`` in
+  the same function, or escape to an owner (passed as a call argument,
+  returned, stored on ``self.X``/``obj.attr``/a container);
+- **class-attr**: ``self.X = <ctor>`` must be closed by SOME method —
+  directly, through a ``p = self._sock; p.close()`` alias (the idiom
+  the thread-join rule already handles), or through the
+  ``for fd in (self._wake_r, self._wake_w): os.close(fd)`` loop the
+  edge's wake-pipe teardown uses;
+- **fire-and-forget**: an acquisition whose handle is dropped on the
+  floor (a bare expression statement) can never be closed.
+
+Flow-insensitive by design: ONE closing site anywhere discharges the
+obligation, so a path that skips it is invisible here — that half of
+the problem belongs to rule 21 ``raise-before-cleanup``. Pure stdlib
+``ast``; linted code is never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from pytorch_cifar_tpu.lint.project import (
+    FuncNode,
+    ModuleInfo,
+    qualname,
+    walk_no_nested_funcs,
+)
+
+_CLOSE_ATTRS = frozenset({"close", "detach"})
+
+
+def _ctor_kind(call: ast.AST, socket_mod: bool) -> Optional[str]:
+    """What fd-holding resource a call acquires: 'socket' / 'pipe' /
+    'fd' / 'file' / 'selector' / 'accept', or None."""
+    if not isinstance(call, ast.Call):
+        return None
+    q = qualname(call.func)
+    if q is None:
+        return None
+    if q in ("socket.socket", "socket.create_connection"):
+        return "socket"
+    if q == "os.pipe":
+        return "pipe"
+    if q == "os.open":
+        return "fd"
+    if q == "open":
+        return "file"
+    if q == "selectors.DefaultSelector" or q.endswith(".DefaultSelector"):
+        return "selector"
+    if socket_mod and q.endswith(".accept") and "." in q:
+        return "accept"
+    return None
+
+
+class FdAnalysis:
+    """The whole-run fd-lifecycle pass. Built lazily by
+    ``ProjectGraph.fds()`` on first use, memoized per module."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self._cache: Dict[str, List[Tuple[int, int, str]]] = {}
+        self._sites: Dict[str, List[Tuple[int, str, str]]] = {}
+
+    def _module(self, path: str) -> Optional[ModuleInfo]:
+        return self.graph.by_path.get(os.path.abspath(path))
+
+    @staticmethod
+    def _imports_socket(m: ModuleInfo) -> bool:
+        return "socket" in m.raw_imports
+
+    def findings_for(self, path: str) -> List[Tuple[int, int, str]]:
+        ap = os.path.abspath(path)
+        if ap not in self._cache:
+            self._analyze_path(ap)
+        return self._cache.get(ap, [])
+
+    def tracked_sites(self, path: str) -> List[Tuple[int, str, str]]:
+        """(line, kind, owner) for every acquisition this pass tracked
+        in ``path`` — the non-vacuity pin for the self-run tests."""
+        ap = os.path.abspath(path)
+        if ap not in self._cache:
+            self._analyze_path(ap)
+        return self._sites.get(ap, [])
+
+    def _analyze_path(self, ap: str) -> None:
+        out: List[Tuple[int, int, str]] = []
+        sites: List[Tuple[int, str, str]] = []
+        self._cache[ap] = out
+        self._sites[ap] = sites
+        m = self._module(ap)
+        if m is None:
+            return
+        socket_mod = self._imports_socket(m)
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(m, node, socket_mod, out, sites)
+            elif isinstance(node, FuncNode):
+                self._check_local(m, node, socket_mod, out, sites)
+            elif isinstance(node, ast.Expr):
+                kind = _ctor_kind(node.value, socket_mod)
+                if kind is not None and kind != "accept":
+                    out.append((
+                        node.value.lineno, node.value.col_offset,
+                        f"{kind} acquired and dropped on the floor — "
+                        f"nothing holds the handle, so nothing can "
+                        f"ever close it",
+                    ))
+        self._check_selector_registration(m, out)
+
+    # -- class-attr obligations ---------------------------------------
+
+    def _check_class(self, m, cls, socket_mod, out, sites) -> None:
+        fd_attrs: Dict[str, Tuple[ast.AST, str]] = {}  # attr -> (ctor, kind)
+        handled: Set[str] = set()
+        for meth in (n for n in cls.body if isinstance(n, FuncNode)):
+            local_fds: Set[str] = set()
+            attr_alias: Dict[str, str] = {}        # local -> self attr
+            loop_alias: Dict[str, Set[str]] = {}   # loop var -> attrs
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign):
+                    kind = _ctor_kind(node.value, socket_mod)
+                    if kind is not None:
+                        for tgt in node.targets:
+                            self._track_targets(
+                                tgt, kind, node.value, fd_attrs,
+                                local_fds,
+                            )
+                        continue
+                    vq = qualname(node.value)
+                    for tgt in node.targets:
+                        tq = qualname(tgt)
+                        if isinstance(tgt, ast.Name):
+                            if vq and vq.startswith("self."):
+                                attr_alias[tgt.id] = vq.split(".", 1)[1]
+                        elif tq and tq.startswith("self.") and (
+                            isinstance(node.value, ast.Name)
+                            and node.value.id in local_fds
+                        ):
+                            # s = socket.socket(); ...; self._sock = s
+                            fd_attrs.setdefault(
+                                tq.split(".", 1)[1],
+                                (node.value, "socket"),
+                            )
+                elif isinstance(node, ast.For) and isinstance(
+                    node.target, ast.Name
+                ) and isinstance(node.iter, (ast.Tuple, ast.List)):
+                    # for fd in (self._wake_r, self._wake_w): ...
+                    attrs = set()
+                    for e in node.iter.elts:
+                        eq = qualname(e)
+                        if eq and eq.startswith("self."):
+                            attrs.add(eq.split(".", 1)[1])
+                    if attrs:
+                        loop_alias[node.target.id] = attrs
+                if isinstance(node, ast.Call):
+                    self._note_close(
+                        node, handled, attr_alias, loop_alias
+                    )
+        for attr, (ctor, kind) in fd_attrs.items():
+            sites.append((ctor.lineno, kind, f"{cls.name}.self.{attr}"))
+            if attr in handled:
+                continue
+            out.append((
+                ctor.lineno, ctor.col_offset,
+                f"{cls.name} stores a {kind} on self.{attr} but no "
+                f"method ever closes it — the fd outlives its owner "
+                f"(the PR 16 leaked-socket shape); close it on every "
+                f"teardown path",
+            ))
+
+    @staticmethod
+    def _track_targets(tgt, kind, ctor, fd_attrs, local_fds) -> None:
+        """Route a ctor's assignment targets: ``self.X`` becomes a
+        class obligation, a plain name a local one; ``os.pipe()`` and
+        ``accept()`` unpacks track each element (the accepted socket
+        is element 0, but closing EITHER element of a pipe pair is not
+        enough, so both are tracked)."""
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            elts = tgt.elts
+            if kind == "accept":
+                elts = elts[:1]  # (conn, addr): only conn holds an fd
+            for e in elts:
+                FdAnalysis._track_targets(
+                    e, kind, ctor, fd_attrs, local_fds
+                )
+            return
+        tq = qualname(tgt)
+        if tq and tq.startswith("self.") and tq.count(".") == 1:
+            fd_attrs.setdefault(tq.split(".", 1)[1], (ctor, kind))
+        elif isinstance(tgt, ast.Name):
+            local_fds.add(tgt.id)
+
+    @staticmethod
+    def _note_close(node, handled, attr_alias, loop_alias) -> None:
+        if isinstance(node.func, ast.Attribute) and (
+            node.func.attr in _CLOSE_ATTRS
+        ):
+            rq = qualname(node.func.value)
+            if rq and rq.startswith("self."):
+                handled.add(rq.split(".", 1)[1])
+            elif isinstance(node.func.value, ast.Name):
+                a = attr_alias.get(node.func.value.id)
+                if a is not None:
+                    handled.add(a)
+        if qualname(node.func) == "os.close" and node.args:
+            arg = node.args[0]
+            aq = qualname(arg)
+            if aq and aq.startswith("self."):
+                handled.add(aq.split(".", 1)[1])
+            elif isinstance(arg, ast.Name):
+                handled.update(loop_alias.get(arg.id, ()))
+                a = attr_alias.get(arg.id)
+                if a is not None:
+                    handled.add(a)
+
+    # -- function-local obligations -----------------------------------
+
+    def _check_local(self, m, fn, socket_mod, out, sites) -> None:
+        local: Dict[str, Tuple[ast.AST, str]] = {}
+        escaped: Set[str] = set()
+        handled: Set[str] = set()
+        for node in walk_no_nested_funcs(fn):
+            if isinstance(node, ast.Assign):
+                kind = _ctor_kind(node.value, socket_mod)
+                if kind is not None:
+                    for tgt in node.targets:
+                        self._track_local_targets(
+                            tgt, kind, node.value, local
+                        )
+                    continue
+                if isinstance(node.value, ast.Name):
+                    for tgt in node.targets:
+                        tq = qualname(tgt)
+                        if (tq and "." in tq) or isinstance(
+                            tgt, ast.Subscript
+                        ):
+                            # self.X = s / obj.attr = s / conns[fd] = s:
+                            # ownership transferred
+                            escaped.add(node.value.id)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name
+                ) and node.func.attr in _CLOSE_ATTRS:
+                    handled.add(node.func.value.id)
+                if qualname(node.func) == "os.close" and node.args:
+                    if isinstance(node.args[0], ast.Name):
+                        handled.add(node.args[0].id)
+                # passed elsewhere (an owner takes it): escapes
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if isinstance(arg, ast.Name):
+                        escaped.add(arg.id)
+            elif isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ):
+                escaped.add(node.value.id)
+        for name, (ctor, kind) in local.items():
+            sites.append((ctor.lineno, kind, f"{fn.name}:{name}"))
+            if name in handled or name in escaped:
+                continue
+            out.append((
+                ctor.lineno, ctor.col_offset,
+                f"local {kind} {name!r} in {fn.name!r} is never "
+                f"closed in this function and never handed to an "
+                f"owner — the fd leaks past every exit path (the "
+                f"PR 16 leaked-socket shape); use `with`, close it, "
+                f"or store it on an owner that does",
+            ))
+
+    @staticmethod
+    def _track_local_targets(tgt, kind, ctor, local) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            elts = tgt.elts[:1] if kind == "accept" else tgt.elts
+            for e in elts:
+                FdAnalysis._track_local_targets(e, kind, ctor, local)
+            return
+        if isinstance(tgt, ast.Name):
+            local[tgt.id] = (ctor, kind)
+        # self.X / container targets are ownership transfers; the
+        # class pass picks up self.X obligations
+
+    # -- selector registration (module-coarse) ------------------------
+
+    def _check_selector_registration(self, m, out) -> None:
+        """A module that registers fileobjs on a selector it OWNS must
+        somewhere unregister them or close the selector (closing the
+        selector releases every registration at once — the teardown
+        idiom serve/edge.py uses)."""
+        sel_names: Set[str] = set()  # 'sel' or 'self._sel' qualnames
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if _ctor_kind(node.value, False) != "selector":
+                continue
+            for tgt in node.targets:
+                tq = qualname(tgt)
+                if tq:
+                    sel_names.add(tq)
+        if not sel_names:
+            return
+        # normalize: 'self._sel' and '_sel'-on-an-alias both count by
+        # their last segment, so a `sel = self._sel` alias still hits
+        last = {q.rsplit(".", 1)[-1] for q in sel_names}
+        first_register = None
+        released = False
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            rq = qualname(node.func.value)
+            if rq is None or rq.rsplit(".", 1)[-1] not in last:
+                continue
+            if node.func.attr == "register":
+                if first_register is None:
+                    first_register = node
+            elif node.func.attr in ("unregister", "close"):
+                released = True
+        if first_register is not None and not released:
+            out.append((
+                first_register.lineno, first_register.col_offset,
+                "this module registers fileobjs on a selector it owns "
+                "but never unregisters them or closes the selector — "
+                "every registration (and its fd reference) leaks at "
+                "teardown; close the selector on the loop's exit path",
+            ))

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from pytorch_cifar_tpu.lint.project import (
     FuncNode,
     ModuleInfo,
+    parents_map,
     qualname,
     walk_no_nested_funcs,
 )
@@ -135,10 +136,7 @@ class _ModuleLockDecls:
 
     def _scan(self) -> None:
         m = self.m
-        parents: Dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(m.tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
+        parents = parents_map(m.tree)
 
         def enclosing(node):
             p = parents.get(node)
@@ -270,10 +268,7 @@ class LockAnalysis:
     def _analyze_module(self, m: ModuleInfo) -> None:
         decls = _ModuleLockDecls(m)
         self.decls[m.path] = decls
-        parents: Dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(m.tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
+        parents = parents_map(m.tree)
         for key, d in m.defs.items():
             if not isinstance(d, FuncNode):
                 continue

@@ -131,15 +131,39 @@ def qualname(node: ast.AST) -> Optional[str]:
     return None
 
 
-def walk_no_nested_funcs(node: ast.AST) -> Iterator[ast.AST]:
-    """Walk ``node``'s subtree but do not descend into nested function
-    definitions (they are analyzed as their own traced/untraced units)."""
+def walk_no_nested_funcs(node: ast.AST) -> List[ast.AST]:
+    """``node``'s subtree without descending into nested function
+    definitions (they are analyzed as their own traced/untraced units).
+
+    The flattened list is memoized ON the node: a def is re-walked by
+    a dozen rules per run, the tree is immutable for the run's
+    lifetime, and the memo dies with the node — no cache to invalidate."""
+    cached = getattr(node, "_graftcheck_wnnf", None)
+    if cached is not None:
+        return cached
+    out: List[ast.AST] = []
     stack = list(ast.iter_child_nodes(node))
     while stack:
         child = stack.pop()
-        yield child
+        out.append(child)
         if not isinstance(child, FuncNode + (ast.Lambda,)):
             stack.extend(ast.iter_child_nodes(child))
+    node._graftcheck_wnnf = out
+    return out
+
+
+def parents_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """child -> parent for every node under ``tree``, built once and
+    memoized on the tree (three independent passes used to rebuild it
+    per module: the graph, the lock analysis, and traced_functions)."""
+    cached = getattr(tree, "_graftcheck_parents", None)
+    if cached is None:
+        cached = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                cached[child] = node
+        tree._graftcheck_parents = cached
+    return cached
 
 
 class ModuleInfo:
@@ -702,10 +726,7 @@ class ProjectGraph:
 
     def _analyze_module(self, m: ModuleInfo) -> None:
         self._collect_sanctions(m)
-        parents: Dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(m.tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
+        parents = parents_map(m.tree)
         # event-loop callback entries (blocking-in-event-loop): in a
         # module that imports ``selectors``, any function passed as the
         # data argument of ``<selector>.register(fileobj, events, cb)``
@@ -898,6 +919,29 @@ class ProjectGraph:
 
             self._locks = LockAnalysis(self)
         return self._locks
+
+    # -- exception-flow analysis (lint/exceptions.py) -------------------
+
+    def exceptions(self):
+        """The whole-run exception-flow pass (may-raise fixpoint,
+        unmapped-edge-exception + raise-before-cleanup findings) —
+        built lazily on first use by an exception rule, memoized."""
+        if getattr(self, "_exceptions", None) is None:
+            from pytorch_cifar_tpu.lint.exceptions import ExceptionFlow
+
+            self._exceptions = ExceptionFlow(self)
+        return self._exceptions
+
+    # -- fd/socket lifecycle analysis (lint/fdlife.py) ------------------
+
+    def fds(self):
+        """The whole-run fd-lifecycle pass (socket/pipe/open/selector
+        escape analysis) — built lazily on first use, memoized."""
+        if getattr(self, "_fds", None) is None:
+            from pytorch_cifar_tpu.lint.fdlife import FdAnalysis
+
+            self._fds = FdAnalysis(self)
+        return self._fds
 
     # -- import graph (CLI: --graph, graph-aware --changed) -------------
 

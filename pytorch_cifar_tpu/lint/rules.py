@@ -1,4 +1,4 @@
-"""graftcheck rules: 17 JAX/concurrency invariants this repo has bled for.
+"""graftcheck rules: 22 JAX/concurrency invariants this repo has bled for.
 
 Every rule is grounded in a failure mode from this repo's own history
 (STATIC_ANALYSIS.md has the catalog with one real-world example each).
@@ -28,6 +28,7 @@ Shared analyses:
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -38,6 +39,7 @@ from pytorch_cifar_tpu.lint.project import (  # noqa: F401  (re-exported)
     TRACER_CALLS,
     TRACER_DECORATORS,
     FuncNode,
+    parents_map,
     qualname,
     walk_no_nested_funcs,
 )
@@ -70,15 +72,22 @@ def traced_functions(ctx: ModuleCtx) -> Set[ast.AST]:
     some other module hands to a tracer (directly, via a re-export, or
     as a factory whose returned closure gets jitted). Closure: defs
     lexically nested in a traced def, and same-module defs called by
-    name from a traced body (one fixpoint)."""
+    name from a traced body (one fixpoint).
+
+    Memoized per file on the run's project handle: three rules ask for
+    the same module's traced set, and the fixpoint is the single most
+    expensive per-file pass in the suite."""
+    cache = getattr(ctx.project, "_traced_fn_cache", None)
+    if cache is None:
+        cache = ctx.project._traced_fn_cache = {}
+    ckey = os.path.abspath(ctx.path)
+    if ckey in cache:
+        return cache[ckey]
     tree = ctx.tree
     defs_by_name: Dict[str, List[ast.AST]] = {}
-    parents: Dict[ast.AST, ast.AST] = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
+    parents = parents_map(tree)
     all_defs: List[ast.AST] = []
-    for node in ast.walk(tree):
+    for node in ctx.nodes():
         if isinstance(node, FuncNode):
             all_defs.append(node)
             defs_by_name.setdefault(node.name, []).append(node)
@@ -109,7 +118,7 @@ def traced_functions(ctx: ModuleCtx) -> Set[ast.AST]:
 
     # self.X = <local def> aliases (the engine's self._fwd pattern)
     self_alias: Dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
+    for node in ctx.nodes():
         if (
             isinstance(node, ast.Assign)
             and isinstance(node.value, ast.Name)
@@ -137,7 +146,7 @@ def traced_functions(ctx: ModuleCtx) -> Set[ast.AST]:
             if d is not None:
                 traced.add(d)
 
-    for node in ast.walk(tree):
+    for node in ctx.nodes():
         if isinstance(node, FuncNode):
             if any(_decorator_traces(d) for d in node.decorator_list):
                 traced.add(node)
@@ -181,6 +190,7 @@ def traced_functions(ctx: ModuleCtx) -> Set[ast.AST]:
                     if d is not None and d not in traced:
                         traced.add(d)
                         changed = True
+    cache[ckey] = traced
     return traced
 
 
@@ -304,7 +314,7 @@ class PrngReuse(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, FuncNode):
                 out.extend(self._check_fn(ctx, node))
         return out
@@ -747,7 +757,7 @@ class DonationMisuse(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, FuncNode):
                 out.extend(self._check_fn(ctx, node))
         return out
@@ -943,7 +953,7 @@ class UnlockedSharedMutation(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, ast.ClassDef):
                 out.extend(self._check_class(ctx, node))
         return out
@@ -1148,7 +1158,7 @@ class CompatBypass(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, ast.ImportFrom):
                 mod = node.module or ""
                 names = {a.name for a in node.names}
@@ -1269,7 +1279,7 @@ class FlagConfigDrift(Rule):
         if not tracked:
             return out
         union_ok = set().union(*fields.values()) | _CFG_ALWAYS_OK
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             # constructor kwargs: TrainConfig(bogus=1)
             if isinstance(node, ast.Call):
                 q = qualname(node.func)
@@ -1316,7 +1326,7 @@ class FlagConfigDrift(Rule):
         annotated params ``config: TrainConfig``, ``self.config = cfg``,
         and simple aliases of any of those."""
         tracked: Dict[str, str] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, FuncNode):
                 for a in node.args.args + node.args.kwonlyargs:
                     ann = a.annotation
@@ -1328,7 +1338,7 @@ class FlagConfigDrift(Rule):
         changed = True
         while changed:
             changed = False
-            for node in ast.walk(ctx.tree):
+            for node in ctx.nodes():
                 if not isinstance(node, ast.Assign):
                     continue
                 cls = None
@@ -1361,7 +1371,7 @@ class FlagConfigDrift(Rule):
             return []
         union = set().union(*fields.values())
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, FuncNode) and node.name in (
                 "parse_config", "parse_serve_config",
             ):
@@ -1535,7 +1545,7 @@ class AtomicPublish(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.nodes():
             if isinstance(fn, FuncNode):
                 out.extend(self._check_rename(ctx, fn))
                 out.extend(self._check_marker_order(ctx, fn))
@@ -1667,10 +1677,10 @@ class ThreadJoin(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, ast.ClassDef):
                 out.extend(self._check_class(ctx, node))
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.nodes():
             if isinstance(fn, FuncNode):
                 out.extend(self._check_local(ctx, fn))
         return out
@@ -1854,15 +1864,15 @@ class SubprocessLifecycle(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, ast.ClassDef):
                 out.extend(self._check_class(ctx, node))
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.nodes():
             if isinstance(fn, FuncNode):
                 out.extend(self._check_local(ctx, fn))
         # fire-and-forget at module level or anywhere: a Popen whose
         # handle is dropped on the floor can never be reaped
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, ast.Expr) and self._is_popen_ctor(
                 node.value
             ):
@@ -2235,7 +2245,7 @@ class BlockingInEventLoop(Rule):
             and node.args
             and isinstance(node.args[0], ast.Constant)
             and node.args[0].value is False
-            for node in ast.walk(ctx.tree)
+            for node in ctx.nodes()
         )
         out = []
         for fn, entry in reach.items():
@@ -2332,12 +2342,12 @@ class JournalWriteOrdering(Rule):
 
     def check(self, ctx: ModuleCtx) -> List[Finding]:
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes():
             if isinstance(node, ast.ClassDef) and (
                 "journal" in node.name.lower()
             ):
                 out.extend(self._check_append_durability(ctx, node))
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.nodes():
             if isinstance(fn, FuncNode):
                 out.extend(self._check_actuation_order(ctx, fn))
                 out.extend(self._check_snapshot_marker(ctx, fn))
@@ -2468,6 +2478,80 @@ class JournalWriteOrdering(Rule):
         return out
 
 
+# ---------------------------------------------------------------------
+# 20-21. exception-flow rules (lint/exceptions.py: the whole-project
+# may-raise fixpoint they both ride on)
+# ---------------------------------------------------------------------
+
+
+class _ExceptionRule(Rule):
+    """Shared shape (same as _LockRule): ask the memoized exception-flow
+    analysis for this module's findings — the fixpoint runs once per
+    lint run, not per rule per file."""
+
+    provider = ""  # ExceptionFlow method name
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        analysis = ctx.project.exception_flow()
+        return [
+            Finding(self.name, ctx.relpath, line, col, msg)
+            for line, col, msg in getattr(analysis, self.provider)(ctx.path)
+        ]
+
+
+class UnmappedEdgeException(_ExceptionRule):
+    name = "unmapped-edge-exception"
+    provider = "edge_findings_for"
+    summary = (
+        "an exception that can escape a frontend/edge dispatch entry "
+        "(a selectors loop callback or do_GET/do_POST handler) with no "
+        "status-code mapping in the handler chain — the loop's "
+        "dispatch-site `except Exception` only logs, so the client "
+        "gets a wedged connection instead of an error response (the "
+        "PR 16 shed-429 parser-mid-state TypeError: the next keep-"
+        "alive request crashed the callback); the OSError family is "
+        "exempt — a dead socket has no client left to answer"
+    )
+
+
+class RaiseBeforeCleanup(_ExceptionRule):
+    name = "raise-before-cleanup"
+    provider = "cleanup_findings_for"
+    summary = (
+        "a may-raise call on a stop/close/drain-shaped path positioned "
+        "BEFORE a resource-releasing call with no shared try/finally — "
+        "the raise skips the release (PR 17: the drain banner's "
+        "`print(..., file=sys.stderr)` raised BrokenPipeError before "
+        "`frontend.stop()`, hanging shutdown 62s); move the release "
+        "into a finally or catch the exception around the call"
+    )
+
+
+# ---------------------------------------------------------------------
+# 22. fd-lifecycle (lint/fdlife.py: rule 17's escape analysis
+# generalized from Popen handles to fds)
+# ---------------------------------------------------------------------
+
+
+class FdLifecycle(Rule):
+    name = "fd-lifecycle"
+    summary = (
+        "a socket/os.pipe/os.open/open/selector acquisition that never "
+        "reaches close/unregister on any path, is not with-scoped, and "
+        "is never handed to an owner that reaps it (class attr closed "
+        "by some method, the `s = self._sock` alias, a container) — "
+        "one fd leaked per iteration is how the PR 16 `Connection: "
+        "close` socket bled the edge"
+    )
+
+    def check(self, ctx: ModuleCtx) -> List[Finding]:
+        analysis = ctx.project.fd_lifecycle()
+        return [
+            Finding(self.name, ctx.relpath, line, col, msg)
+            for line, col, msg in analysis.findings_for(ctx.path)
+        ]
+
+
 RULES = (
     JitImpurity(),
     PrngReuse(),
@@ -2488,6 +2572,9 @@ RULES = (
     MetricNameDrift(),
     BlockingInEventLoop(),
     JournalWriteOrdering(),
+    UnmappedEdgeException(),
+    RaiseBeforeCleanup(),
+    FdLifecycle(),
 )
 
 

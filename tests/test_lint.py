@@ -74,6 +74,11 @@ def test_rule_registry_has_at_least_sixteen_rules():
     assert "blocking-in-event-loop" in rule_names()
     # the durable-control-plane PR's journal discipline rule
     assert "journal-write-ordering" in rule_names()
+    # the v4 whole-project passes: exception flow + fd lifecycle
+    for name in (
+        "unmapped-edge-exception", "raise-before-cleanup", "fd-lifecycle",
+    ):
+        assert name in rule_names()
 
 
 def test_suppression_requires_reason(tmp_path):
@@ -2031,3 +2036,290 @@ def test_journal_write_ordering_self_run_clean_and_not_vacuous():
         )
         both += bool(has_append and has_act)
     assert both >= 3  # _spawn_one, _drain_one, _reap_dead at least
+
+# ---------------------------------------------------------------------
+# unmapped-edge-exception / raise-before-cleanup (the v4 exception-flow
+# pass) + fd-lifecycle (the v4 resource pass)
+# ---------------------------------------------------------------------
+
+# The PR 16 shed-429 bug, distilled: _begin_request answers the 429 and
+# flips conn.state to _READ_BODY *without arming conn.body*, so the
+# body bytes that follow hit _feed_body's TypeError guard — which
+# nothing on the dispatch path maps to a status, so the raw exception
+# escapes into the event loop's crash logger and the client hangs.
+_EDGE_BUG = """
+    import selectors
+
+    _READ_HEAD, _READ_BODY = 0, 1
+
+
+    class EdgeFrontend:
+        def _arm(self, conn):
+            self._sel.register(
+                conn.sock, selectors.EVENT_READ, self._on_conn_event
+            )
+
+        def _on_conn_event(self, key, mask):
+            conn = key.data_conn
+            self._feed(conn, conn.sock.recv(4096))
+
+        def _feed(self, conn, data):
+            if conn.state == _READ_HEAD:
+                head, _, rest = data.partition(b"\\r\\n\\r\\n")
+                if not self._begin_request(conn, head):
+                    return
+                if rest:
+                    self._feed_body(conn, rest)
+            elif conn.state == _READ_BODY:
+                self._feed_body(conn, data)
+
+        def _begin_request(self, conn, head):
+            try:
+                method, path = _parse_head(head)
+            except ValueError:
+                self._send_error(conn, 400)
+                return False
+            if self._shedding:
+                self._send_error(conn, 429)
+                conn.state = _READ_BODY
+                return True
+            conn.state = _READ_BODY
+            conn.body = bytearray(64)
+            return True
+
+        def _feed_body(self, conn, data):
+            if conn.body is None:
+                raise TypeError("body buffer never armed")
+            conn.body[: len(data)] = data
+
+        def _send_error(self, conn, code):
+            conn.sock.send(b"HTTP/1.1 %d x\\r\\n\\r\\n" % code)
+
+
+    def _parse_head(head):
+        parts = head.split()
+        if len(parts) < 2:
+            raise ValueError("malformed request head")
+        return parts[0], parts[1]
+"""
+
+
+def _lint_edge_fixture(tmp_path, src):
+    d = tmp_path / "serve"
+    d.mkdir(exist_ok=True)
+    p = d / "edge.py"
+    p.write_text(textwrap.dedent(src))
+    return lint_file(
+        str(p), rules=rules_by_name(["unmapped-edge-exception"])
+    )
+
+
+def test_unmapped_edge_exception_positive(tmp_path):
+    """The PR 16 shed-429 shape: a TypeError three calls below the
+    dispatch entry escapes unmapped — the rule names the exception, its
+    origin, and fires at the registered callback."""
+    found = _lint_edge_fixture(tmp_path, _EDGE_BUG)
+    assert found, "expected the TypeError escape to be reported"
+    msgs = "\n".join(f.message for f in found)
+    assert "TypeError" in msgs
+    assert "_feed_body" in msgs
+    # anchored at the dispatch entry, not buried at the raise site
+    assert any("_on_conn_event" in f.message for f in found)
+    # ValueError from _parse_head is mapped to a 400 — NOT reported
+    assert "ValueError" not in msgs
+
+
+def test_unmapped_edge_exception_negative_mapped(tmp_path):
+    """The fix: the entry maps TypeError to a 500 response, so every
+    non-exempt exception on the dispatch path now has a status."""
+    fixed = _EDGE_BUG.replace(
+        "            conn = key.data_conn\n"
+        "            self._feed(conn, conn.sock.recv(4096))\n",
+        "            conn = key.data_conn\n"
+        "            try:\n"
+        "                self._feed(conn, conn.sock.recv(4096))\n"
+        "            except TypeError:\n"
+        "                self._send_error(conn, 500)\n",
+    )
+    assert fixed != _EDGE_BUG
+    assert _lint_edge_fixture(tmp_path, fixed) == []
+
+
+def test_unmapped_edge_exception_is_path_insensitive(tmp_path):
+    """Re-arming the parser state alone (PR 16's actual patch) does
+    NOT silence the rule: the raise stays reachable in the analysis,
+    so the guard must be *mapped*, not merely dodged. This is the
+    conservative choice — the rule demands a status mapping."""
+    rearmed = _EDGE_BUG.replace(
+        "                conn.state = _READ_BODY\n"
+        "                return True\n"
+        "            conn.state = _READ_BODY\n",
+        "                conn.state = _READ_HEAD\n"
+        "                return False\n"
+        "            conn.state = _READ_BODY\n",
+    )
+    assert rearmed != _EDGE_BUG
+    assert _lint_edge_fixture(tmp_path, rearmed), (
+        "path-insensitive analysis should still report the guard"
+    )
+
+
+def test_raise_before_cleanup_positive(tmp_path):
+    """The PR 17 drain bug: a banner print(file=sys.stderr) ahead of
+    frontend.stop() — a BrokenPipeError there skips the stop and the
+    drain hangs for the full grace period."""
+    src = """
+    import sys
+
+
+    class Server:
+        def drain(self):
+            print("==> http: draining", file=sys.stderr)
+            self.frontend.stop()
+            self.exporter.stop()
+    """
+    found = run_rule(tmp_path, src, "raise-before-cleanup")
+    assert found
+    msg = found[0].message
+    assert "OSError" in msg and "stop" in msg
+    # anchored at the print, the call that can skip the releases
+    assert found[0].line == 7
+
+
+def test_raise_before_cleanup_negative(tmp_path):
+    """The shipped fix shape: the banner is wrapped so an OSError on
+    stderr cannot skip the stops."""
+    src = """
+    import sys
+
+
+    class Server:
+        def drain(self):
+            try:
+                print("==> http: draining", file=sys.stderr)
+            except OSError:
+                pass
+            self.frontend.stop()
+            self.exporter.stop()
+    """
+    assert run_rule(tmp_path, src, "raise-before-cleanup") == []
+
+
+def test_fd_lifecycle_local_socket_positive(tmp_path):
+    src = """
+    import socket
+
+
+    def probe(host):
+        s = socket.socket()
+        s.connect((host, 80))
+        return s.recv(1)
+    """
+    found = run_rule(tmp_path, src, "fd-lifecycle")
+    assert found
+    assert "never closed" in found[0].message
+
+
+def test_fd_lifecycle_with_scope_negative(tmp_path):
+    src = """
+    import socket
+
+
+    def probe(host):
+        with socket.socket() as s:
+            s.connect((host, 80))
+            return s.recv(1)
+    """
+    assert run_rule(tmp_path, src, "fd-lifecycle") == []
+
+
+def test_fd_lifecycle_class_owner(tmp_path):
+    """Storing on self discharges the local obligation — but only if
+    some method of the class actually closes the attribute."""
+    owned = """
+    import socket
+
+
+    class Client:
+        def connect(self, host):
+            s = socket.socket()
+            s.connect((host, 80))
+            self._sock = s
+
+        def close(self):
+            self._sock.close()
+    """
+    assert run_rule(tmp_path, owned, "fd-lifecycle") == []
+    leaky = """
+    import socket
+
+
+    class Client:
+        def connect(self, host):
+            self._sock = socket.socket()
+            self._sock.connect((host, 80))
+
+        def close(self):
+            pass
+    """
+    found = run_rule(tmp_path, leaky, "fd-lifecycle")
+    assert found
+    assert "self._sock" in found[0].message
+
+
+def test_exception_flow_self_run_clean_and_not_vacuous():
+    """The shipped edge passes rules 20-21 with ZERO suppressions —
+    and not because the pass saw nothing: the dispatch entries of the
+    real serve/edge.py must be found and a substantial closure
+    analyzed behind them."""
+    from pytorch_cifar_tpu.lint.engine import _Project
+
+    serve_dir = os.path.join(PKG, "serve")
+    edge = os.path.join(serve_dir, "edge.py")
+    with open(edge) as f:
+        text = f.read()
+    assert "noqa[unmapped-edge-exception]" not in text
+    assert "noqa[raise-before-cleanup]" not in text
+    run = lint_paths(
+        [serve_dir], repo_root=REPO,
+        rules=rules_by_name(
+            ["unmapped-edge-exception", "raise-before-cleanup"]
+        ),
+    )
+    found = [f for f in run.findings if f.status == "open"]
+    assert found == [], "\n".join(f.render() for f in found)
+    proj = _Project(REPO, [edge])
+    flow = proj.graph().exceptions()
+    entries = flow.dispatch_entries_for(edge)
+    assert {
+        "EdgeFrontend._on_accept", "EdgeFrontend._on_conn_event",
+        "EdgePool._on_conn_event",
+    } <= set(entries)
+    # the pass walked the request path, not just the entry defs
+    assert len(flow.entry_closure_keys(edge)) >= 20
+
+
+def test_fd_lifecycle_self_run_clean_and_not_vacuous():
+    """The shipped edge passes rule 22 with ZERO suppressions — and
+    the pass really tracked its sockets, selectors and wake pipes."""
+    from pytorch_cifar_tpu.lint.engine import _Project
+
+    serve_dir = os.path.join(PKG, "serve")
+    edge = os.path.join(serve_dir, "edge.py")
+    with open(edge) as f:
+        assert "noqa[fd-lifecycle]" not in f.read()
+    run = lint_paths(
+        [serve_dir], repo_root=REPO,
+        rules=rules_by_name(["fd-lifecycle"]),
+    )
+    found = [f for f in run.findings if f.status == "open"]
+    assert found == [], "\n".join(f.render() for f in found)
+    proj = _Project(REPO, [edge])
+    sites = proj.graph().fds().tracked_sites(edge)
+    assert len(sites) >= 6
+    kinds = {k for _, k, _ in sites}
+    assert {"socket", "selector", "pipe"} <= kinds
+    owners = {o for _, _, o in sites}
+    assert "EdgeFrontend.self._listener" in owners
+    assert "EdgeFrontend.self._wake_r" in owners
+    assert "EdgeFrontend.self._wake_w" in owners

@@ -1182,3 +1182,117 @@ def test_journal_inspect_replayable_torn_and_corrupt(tmp_path):
 
     # unreadable path is a usage error (exit 2, stderr message)
     assert _jinspect(tmp_path / "nope.journal").returncode == 2
+
+def test_lint_cli_docs_rule_catalog(tmp_path):
+    """`--docs` also cross-checks the RULE catalog: every registered
+    rule needs a STATIC_ANALYSIS.md `### \\`name\\`` entry, no entry may
+    outlive its rule, and README's 'N rules total' must equal the
+    registry. The real repo must report in-sync; a drifted fixture repo
+    must warn on all three axes."""
+    import shutil
+    import subprocess as sp
+
+    # the shipped docs are in sync with the shipped registry
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    lint = os.path.join(REPO, "tools", "lint.py")
+    r = _run_tool([lint, "--no-baseline", "--docs", str(clean)])
+    assert "rule catalog in sync" in r.stdout, r.stdout
+    assert "WARNING rule" not in r.stdout
+    assert "WARNING README.md" not in r.stdout
+
+    # a drifted fixture: missing entry, stale entry, wrong README count
+    repo = tmp_path / "r"
+    (repo / "tools").mkdir(parents=True)
+    shutil.copy(lint, repo / "tools" / "lint.py")
+    pkg = repo / "pytorch_cifar_tpu"
+    shutil.copytree(
+        os.path.join(REPO, "pytorch_cifar_tpu", "lint"), pkg / "lint"
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text("")
+    from pytorch_cifar_tpu.lint.rules import rule_names
+
+    names = list(rule_names())
+    entries = "".join(
+        "### `%s`\n\ntext.\n\n" % n for n in names if n != "prng-reuse"
+    )
+    (repo / "STATIC_ANALYSIS.md").write_text(
+        entries + "### `ghost-rule`\n\nrenamed away.\n"
+    )
+    (repo / "README.md").write_text("graftcheck — 7 rules total.\n")
+    r = sp.run(
+        [sys.executable, str(repo / "tools" / "lint.py"),
+         "--no-baseline", "--docs", str(clean)],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "WARNING rule 'prng-reuse' is registered" in r.stdout
+    assert "'ghost-rule' but the registry does not define it" in r.stdout
+    assert "advertises '7 rules total'" in r.stdout
+    assert "rule catalog in sync" not in r.stdout
+
+
+def test_precommit_hook_blocks_seeded_fd_leak(tmp_path):
+    """The v4 drill: a leaked socket seeded in ONE staged module blocks
+    a real `git commit` through `--changed` with a [fd-lifecycle]
+    finding; the with-scoped rewrite lands."""
+    import shutil
+    import stat
+    import subprocess as sp
+
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = dict(os.environ)
+    env.update(
+        GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+        GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+        PYTHON=sys.executable,
+    )
+
+    def git(*args):
+        sp.run(["git", *args], cwd=repo, check=True, env=env,
+               capture_output=True)
+
+    git("init", "-q")
+    tools = repo / "tools"
+    (tools / "githooks").mkdir(parents=True)
+    for rel in (("tools", "lint.py"), ("tools", "githooks", "pre-commit")):
+        shutil.copy(os.path.join(REPO, *rel), tools / os.path.join(*rel[1:]))
+    hook = tools / "githooks" / "pre-commit"
+    hook.chmod(hook.stat().st_mode | stat.S_IXUSR)
+    pkg = repo / "pytorch_cifar_tpu"
+    shutil.copytree(
+        os.path.join(REPO, "pytorch_cifar_tpu", "lint"), pkg / "lint"
+    )
+    (pkg / "__init__.py").write_text("")
+    (pkg / "config.py").write_text("")
+    git("config", "core.hooksPath", "tools/githooks")
+
+    probe = repo / "probe.py"
+    probe.write_text(
+        "import socket\n\n\ndef probe(host):\n"
+        "    s = socket.socket()\n"
+        "    s.connect((host, 80))\n"
+        "    return s.recv(1)\n"
+    )
+    git("add", "probe.py")
+    r = sp.run([str(hook)], cwd=repo, env=env, capture_output=True,
+               text=True, timeout=120)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "probe.py" in r.stdout and "[fd-lifecycle]" in r.stdout
+    c = sp.run(["git", "commit", "-qm", "leak"], cwd=repo, env=env,
+               capture_output=True, text=True, timeout=120)
+    assert c.returncode != 0, (c.stdout, c.stderr)
+    # the with-scoped fix sails through: hook exits 0, commit lands
+    probe.write_text(
+        "import socket\n\n\ndef probe(host):\n"
+        "    with socket.socket() as s:\n"
+        "        s.connect((host, 80))\n"
+        "        return s.recv(1)\n"
+    )
+    git("add", "probe.py")
+    r = sp.run([str(hook)], cwd=repo, env=env, capture_output=True,
+               text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    git("commit", "-qm", "scoped")

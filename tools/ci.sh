@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# CI entry point: graftcheck (SARIF artifact) + the tier-1 suite.
+#
+# Zero dependencies beyond python + pytest: the lint half is pure
+# stdlib and MUST pass even where jax is absent, so a docs-only or
+# tools-only change still gets the full static gate. The tier-1 half
+# is the exact command ROADMAP.md pins — keep the two in sync by
+# editing ROADMAP.md first.
+#
+# Usage: bash tools/ci.sh [lint|tier1|all]   (default: all)
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+stage="${1:-all}"
+rc=0
+
+if [ "$stage" = "lint" ] || [ "$stage" = "all" ]; then
+    echo "==> ci: graftcheck (SARIF -> graftcheck.sarif)"
+    "$PY" tools/lint.py --sarif > graftcheck.sarif
+    lint_rc=$?
+    # the SARIF file is written either way; rc 1 = open findings
+    "$PY" tools/lint.py --docs || lint_rc=$?
+    if [ "$lint_rc" -ne 0 ]; then
+        echo "==> ci: graftcheck FAILED (rc=$lint_rc)" >&2
+        rc=1
+    fi
+fi
+
+if [ "$stage" = "tier1" ] || [ "$stage" = "all" ]; then
+    echo "==> ci: tier-1 (ROADMAP.md verify command)"
+    set -o pipefail
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu "$PY" -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+        | tee /tmp/_t1.log
+    t1_rc=${PIPESTATUS[0]}
+    echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+    if [ "$t1_rc" -ne 0 ]; then
+        echo "==> ci: tier-1 FAILED (rc=$t1_rc)" >&2
+        rc=1
+    fi
+fi
+
+exit "$rc"

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import subprocess
 import sys
 
@@ -131,7 +132,8 @@ def docs_report(run) -> list:
     template rows the parser already skips."""
     doc_names = run.project.metric_doc_names() if run.project else None
     if doc_names is None:
-        return ["graftcheck docs: no OBSERVABILITY.md at the repo root"]
+        return (["graftcheck docs: no OBSERVABILITY.md at the repo root"]
+                + rule_catalog_report())
     from pytorch_cifar_tpu.lint.rules import (
         metric_dynamic_prefixes,
         metric_literals,
@@ -162,6 +164,76 @@ def docs_report(run) -> list:
         "graftcheck docs: %d metric literal(s) in code, %d documented, "
         "%d documented-but-uncreated" % (
             len(created), len(doc_names), len(stale)
+        )
+    )
+    out.extend(rule_catalog_report())
+    return out
+
+
+def rule_catalog_report() -> list:
+    """The rule-catalog drift half of `--docs`: every registered rule
+    must have a STATIC_ANALYSIS.md catalog entry (a ``### `rule-name` ``
+    heading), no entry may outlive its rule, and README's advertised
+    "N rules total" must equal the registry — that count needed a
+    manual bump on every lint PR until it was made self-enforcing
+    here (and promptly turned out to be two behind)."""
+    from pytorch_cifar_tpu.lint.rules import rule_names
+
+    registered = set(rule_names())
+    out: list = []
+    catalog_path = os.path.join(REPO, "STATIC_ANALYSIS.md")
+    try:
+        with open(catalog_path, encoding="utf-8") as f:
+            catalog = set(
+                re.findall(r"^###\s+`([a-z0-9-]+)`", f.read(), re.M)
+            )
+    except OSError:
+        return ["graftcheck docs: no STATIC_ANALYSIS.md at the repo root"]
+    for name in sorted(registered - catalog):
+        out.append(
+            "graftcheck docs: WARNING rule %r is registered but has no "
+            "STATIC_ANALYSIS.md catalog entry — every rule documents "
+            "the real failure it is grounded in (add a ### `%s` "
+            "section)" % (name, name)
+        )
+    for name in sorted(catalog - registered):
+        out.append(
+            "graftcheck docs: WARNING STATIC_ANALYSIS.md documents "
+            "rule %r but the registry does not define it — stale "
+            "after a rename? (remove the section or restore the rule)"
+            % name
+        )
+    readme_path = os.path.join(REPO, "README.md")
+    try:
+        with open(readme_path, encoding="utf-8") as f:
+            counts = re.findall(r"(\d+)\s+rules\s+total", f.read())
+    except OSError:
+        counts = []
+    if not counts:
+        out.append(
+            "graftcheck docs: WARNING README.md never states the "
+            "rule count ('N rules total') — the advertised surface "
+            "should be self-enforcing"
+        )
+    else:
+        for c in counts:
+            if int(c) != len(registered):
+                out.append(
+                    "graftcheck docs: WARNING README.md advertises "
+                    "'%s rules total' but the registry has %d — the "
+                    "count drifts on every lint PR unless this check "
+                    "fails loudly" % (c, len(registered))
+                )
+    in_sync = (
+        not (registered ^ catalog)
+        and bool(counts)
+        and all(int(c) == len(registered) for c in counts)
+    )
+    out.append(
+        "graftcheck docs: %d rule(s) registered, %d cataloged in "
+        "STATIC_ANALYSIS.md, rule catalog %s" % (
+            len(registered), len(catalog),
+            "in sync" if in_sync else "DRIFTED",
         )
     )
     return out
